@@ -1,0 +1,96 @@
+"""Benchmark: FedAvg local-training throughput + aggregation, north-star
+workload (ResNet-56 / CIFAR-10-shaped data, batch 64 — BASELINE.md).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The reference publishes no throughput numbers (BASELINE.md), so
+``vs_baseline`` is computed against an estimated reference-hardware
+figure: PyTorch ResNet-56/CIFAR-10 training on the RTX-2080-Ti-class
+GPUs the reference's cluster used sustains roughly 1500 samples/s per
+GPU (per-client serial training, as in the reference's one-process-per-
+client design). vs_baseline = our samples/s / 1500.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+REFERENCE_GPU_SAMPLES_PER_SEC = 1500.0
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--steps", type=int, default=24)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--rounds", type=int, default=3)
+    args = p.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.fedavg import ServerState, make_round_fn
+    from fedml_tpu.core.client import make_client_optimizer, make_local_update
+    from fedml_tpu.models.resnet import resnet56
+
+    bundle = resnet56(num_classes=10)
+    opt = make_client_optimizer("sgd", 0.001, momentum=0.9, weight_decay=0.001)
+    local_update = make_local_update(bundle, opt, epochs=args.epochs)
+    round_fn = jax.jit(make_round_fn(local_update))
+
+    rng = np.random.RandomState(0)
+    C, S, B = args.clients, args.steps, args.batch
+    x = jnp.asarray(rng.rand(C, S, B, 32, 32, 3).astype(np.float32))
+    y = jnp.asarray(rng.randint(0, 10, (C, S, B)).astype(np.int32))
+    mask = jnp.ones((C, S, B), jnp.float32)
+    num_samples = jnp.full((C,), S * B, jnp.float32)
+    participation = jnp.ones((C,), jnp.float32)
+    slot_ids = jnp.arange(C, dtype=jnp.int32)
+
+    key = jax.random.PRNGKey(0)
+    state = ServerState(
+        variables=bundle.init(key),
+        opt_state=(),
+        round_idx=jnp.zeros((), jnp.int32),
+        key=key,
+    )
+
+    # warmup / compile — two threaded rounds: the second input signature
+    # (device-committed state) compiles separately from the first
+    for _ in range(2):
+        state, _ = round_fn(state, x, y, mask, num_samples, participation, slot_ids)
+    jax.block_until_ready(state.variables)
+
+    t0 = time.perf_counter()
+    loss = 0.0
+    for _ in range(args.rounds):
+        state, metrics = round_fn(
+            state, x, y, mask, num_samples, participation, slot_ids
+        )
+        loss = float(metrics["loss_sum"])  # forced readback: no async escape
+    jax.block_until_ready(state.variables)
+    dt = time.perf_counter() - t0
+    assert np.isfinite(loss)
+
+    samples = C * S * B * args.epochs * args.rounds
+    sps = samples / dt
+    print(
+        json.dumps(
+            {
+                "metric": "fedavg_resnet56_cifar10_local_train_throughput",
+                "value": round(sps, 1),
+                "unit": "samples/sec",
+                "vs_baseline": round(sps / REFERENCE_GPU_SAMPLES_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,22 @@
+"""fedml_tpu — a TPU-native federated learning framework.
+
+Built from scratch in JAX/XLA with the capabilities of FedML's research
+library (reference: yh-yao/FedML). A TPU pod slice acts as the federated
+cluster: each chip hosts one (or more) FL clients on a ``clients`` mesh
+axis; the reference's MPI message-passing runtime
+(fedml_core/distributed/communication) is replaced by XLA collectives —
+broadcast by replication for model sync, masked weighted ``lax.psum``
+for aggregation with per-round client subsampling.
+
+Layer map (mirrors SURVEY.md §1, redesigned TPU-first):
+
+  experiments/   entry points (typed config + CLI)          [ref L5]
+  algorithms/    FedAvg family, GKT, SplitNN, VFL, NAS ...  [ref L4]
+  models/ data/  flax model zoo + federated data pipeline   [ref L3]
+  core/ comm/    round engine, partitioners, messages       [ref L2]
+  parallel/ ops/ mesh/shard_map + pallas kernels            [ref L1]
+"""
+
+__version__ = "0.1.0"
+
+from fedml_tpu.core.types import FedDataset  # noqa: F401

@@ -1,0 +1,88 @@
+"""Centralized (non-federated) baseline trainer.
+
+Reference: ``fedml_api/centralized/centralized_trainer.py:9-70`` — trains
+on the union of all clients' data with the same data contract, used by
+the CI equivalence oracle (FedAvg == centralized at full participation /
+full batch / E=1, ``CI-script-fedavg.sh:42-48``).  Here it is literally
+the same local-update kernel applied to one "client" holding everything,
+which makes the oracle a structural identity rather than a coincidence.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.client import make_client_optimizer, make_evaluator, make_local_update
+from fedml_tpu.core.losses import LossFn, masked_softmax_ce
+from fedml_tpu.core.types import FedDataset, batch_eval_pack
+from fedml_tpu.models.base import ModelBundle
+
+
+class CentralizedTrainer:
+    def __init__(
+        self,
+        bundle: ModelBundle,
+        dataset: FedDataset,
+        *,
+        epochs_per_call: int = 1,
+        batch_size: int = 64,
+        optimizer: str = "sgd",
+        lr: float = 0.03,
+        momentum: float = 0.0,
+        weight_decay: float = 0.0,
+        grad_clip: Optional[float] = None,
+        loss_fn: LossFn = masked_softmax_ce,
+        seed: int = 0,
+        shuffle: bool = True,
+    ):
+        self.bundle = bundle
+        self.dataset = dataset
+        opt = make_client_optimizer(
+            optimizer, lr, momentum=momentum, weight_decay=weight_decay, grad_clip=grad_clip
+        )
+        self.update = jax.jit(
+            make_local_update(
+                bundle, opt, epochs_per_call, loss_fn, shuffle=shuffle
+            ).fn
+        )
+        self.evaluator = make_evaluator(bundle, loss_fn)
+        self.key = jax.random.PRNGKey(seed)
+        self.variables = bundle.init(self.key)
+        self._train_pack = batch_eval_pack(dataset.train_x, dataset.train_y, batch_size)
+        self._test_pack = batch_eval_pack(
+            dataset.test_x, dataset.test_y, max(batch_size, 64)
+        )
+        self.epoch = 0
+
+    def train(self, epochs: int = 1) -> dict:
+        x, y, m = self._train_pack
+        metrics = {}
+        for _ in range(epochs):
+            self.variables, metrics = self.update(
+                self.variables,
+                jnp.asarray(x),
+                jnp.asarray(y),
+                jnp.asarray(m),
+                jax.random.fold_in(self.key, 17 + self.epoch),
+            )
+            self.epoch += 1
+        out = {k: float(v) for k, v in metrics.items()}
+        if out.get("count"):
+            out["train_acc"] = out["correct"] / out["count"]
+            out["train_loss"] = out["loss_sum"] / out["count"]
+        return out
+
+    def evaluate(self) -> dict:
+        x, y, m = self._test_pack
+        res = self.evaluator(
+            self.variables, jnp.asarray(x), jnp.asarray(y), jnp.asarray(m)
+        )
+        c = float(res["count"])
+        return {
+            "test_acc": float(res["correct"]) / max(c, 1.0),
+            "test_loss": float(res["loss_sum"]) / max(c, 1.0),
+        }

@@ -1,0 +1,57 @@
+"""Masked loss / metric functions.
+
+Padding-by-wrapping (core.types.pack_clients) means every batch may
+contain duplicate "pad" samples; all losses here take a ``mask`` and
+normalize by the real-sample count so padded slots contribute exactly
+zero gradient and zero metric weight.  This replaces the reference's
+reliance on torch DataLoader ragged last batches
+(``MyModelTrainer.py:44-52``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# A LossFn maps (logits, targets, mask) -> (mean_loss, aux_metrics)
+LossFn = Callable[[jax.Array, jax.Array, jax.Array], Tuple[jax.Array, dict]]
+
+
+def masked_softmax_ce(logits: jax.Array, y: jax.Array, mask: jax.Array):
+    """Cross-entropy with integer targets; mean over mask.
+
+    Handles both [B, C] classification and [B, T, C] sequence shapes
+    (Shakespeare/StackOverflow next-token tasks); for sequences the mask
+    is broadcast over time unless given per-token.
+    """
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, y[..., None].astype(jnp.int32), axis=-1)[..., 0]
+    if nll.ndim > mask.ndim:
+        mask = jnp.broadcast_to(mask[..., None], nll.shape)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (nll * mask).sum() / denom
+    pred = jnp.argmax(logits, axis=-1)
+    correct = ((pred == y) * mask).sum()
+    return loss, {"loss_sum": (nll * mask).sum(), "correct": correct, "count": mask.sum()}
+
+
+def masked_bce_logits(logits: jax.Array, y: jax.Array, mask: jax.Array):
+    """Binary cross-entropy on logits (VFL / lending-club binary tasks)."""
+    logits = logits.astype(jnp.float32).reshape(y.shape)
+    yf = y.astype(jnp.float32)
+    per = jnp.maximum(logits, 0) - logits * yf + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per * mask).sum() / denom
+    pred = (logits > 0).astype(yf.dtype)
+    correct = ((pred == yf) * mask).sum()
+    return loss, {"loss_sum": (per * mask).sum(), "correct": correct, "count": mask.sum()}
+
+
+def masked_mse(preds: jax.Array, y: jax.Array, mask: jax.Array):
+    preds = preds.astype(jnp.float32).reshape(y.shape)
+    per = jnp.square(preds - y.astype(jnp.float32))
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per * mask).sum() / denom
+    return loss, {"loss_sum": (per * mask).sum(), "correct": jnp.zeros(()), "count": mask.sum()}

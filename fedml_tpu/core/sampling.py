@@ -1,0 +1,54 @@
+"""Per-round client sampling → participation masks.
+
+The reference samples clients on the server each round with
+``np.random.seed(round_idx); np.random.choice(...)``
+(``FedAVGAggregator.py:89-97``).  TPU-natively, sampling becomes a
+deterministic function of (key, round) via ``jax.random.fold_in`` and the
+result is expressed as a boolean participation mask over the full client
+axis, so subsampling is just a collective mask inside the aggregation
+psum — unsampled chips contribute zeros and no control flow diverges.
+
+The fork's hardcoded post-init sampling formula
+(``FedAvgServerManager.py:66-75``) is a known defect (SURVEY.md §7) and is
+deliberately NOT replicated: every round uses seeded uniform sampling.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_clients(
+    key: jax.Array, round_idx, num_clients: int, num_per_round: int
+) -> jax.Array:
+    """Seeded uniform choice of ``num_per_round`` distinct client ids.
+
+    Jit-safe (round_idx may be traced). Equals the reference's
+    ``client_sampling`` semantics (uniform, without replacement,
+    deterministic per round); returns int32 ids of shape [num_per_round].
+    If all clients participate, returns arange (reference ``:92-93``).
+    """
+    if num_per_round >= num_clients:
+        return jnp.arange(num_clients, dtype=jnp.int32)
+    k = jax.random.fold_in(key, round_idx)
+    perm = jax.random.permutation(k, num_clients)
+    return perm[:num_per_round].astype(jnp.int32)
+
+
+def participation_mask(
+    key: jax.Array, round_idx, num_clients: int, num_per_round: int
+) -> jax.Array:
+    """[num_clients] float mask with exactly ``num_per_round`` ones."""
+    ids = sample_clients(key, round_idx, num_clients, num_per_round)
+    return jnp.zeros(num_clients, jnp.float32).at[ids].set(1.0)
+
+
+def mask_and_ids(
+    key: jax.Array, round_idx, num_clients: int, num_per_round: int
+) -> Tuple[jax.Array, jax.Array]:
+    ids = sample_clients(key, round_idx, num_clients, num_per_round)
+    mask = jnp.zeros(num_clients, jnp.float32).at[ids].set(1.0)
+    return mask, ids

@@ -1,0 +1,196 @@
+"""Typed federated data contract.
+
+The reference's universal data contract is the 8-tuple returned by every
+``load_partition_data_<dataset>`` function (see
+``/root/reference/fedml_api/data_preprocessing/cifar10/data_loader.py:235-269``
+and the ``load_data`` switch at
+``fedml_experiments/distributed/fedavg/main_fedavg.py:108-214``):
+
+    [train_data_num, test_data_num, train_data_global, test_data_global,
+     train_data_local_num_dict, train_data_local_dict, test_data_local_dict,
+     class_num]
+
+Here that contract becomes one typed, framework-owned structure,
+``FedDataset``, holding numpy arrays on the host plus per-client index
+lists.  Device-side, heterogeneous per-client data must become fixed
+shape to be jit/SPMD-friendly, so ``ClientBatches`` packs K clients into
+``[K, steps, batch, ...]`` arrays with a sample mask (pad-by-wrapping so
+BatchNorm statistics never see zero images; the mask zeroes duplicate
+samples out of losses and counts).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+Array = Any  # np.ndarray or jax.Array
+
+
+@dataclasses.dataclass
+class FedDataset:
+    """Host-side federated dataset: global arrays + per-client partitions."""
+
+    train_x: np.ndarray
+    train_y: np.ndarray
+    test_x: np.ndarray
+    test_y: np.ndarray
+    # client id -> indices into train_x / test_x
+    train_client_idx: Dict[int, np.ndarray]
+    test_client_idx: Optional[Dict[int, np.ndarray]]
+    num_classes: int
+    name: str = "dataset"
+
+    @property
+    def num_clients(self) -> int:
+        return len(self.train_client_idx)
+
+    @property
+    def train_data_num(self) -> int:
+        return int(self.train_x.shape[0])
+
+    @property
+    def test_data_num(self) -> int:
+        return int(self.test_x.shape[0])
+
+    def client_sample_counts(self) -> np.ndarray:
+        """[num_clients] number of training samples per client."""
+        return np.array(
+            [len(self.train_client_idx[c]) for c in range(self.num_clients)],
+            dtype=np.int32,
+        )
+
+    def legacy_tuple(self, batch_size: int) -> Tuple:
+        """The reference's 8-tuple, for parity-checking and migration.
+
+        ``train_data_global``/locals are lists of (x, y) numpy batches, the
+        shape the reference's torch DataLoaders would yield.
+        """
+        def batches(x, y):
+            return [
+                (x[i : i + batch_size], y[i : i + batch_size])
+                for i in range(0, len(x), batch_size)
+            ]
+
+        train_local_num = {c: len(ix) for c, ix in self.train_client_idx.items()}
+        train_local = {
+            c: batches(self.train_x[ix], self.train_y[ix])
+            for c, ix in self.train_client_idx.items()
+        }
+        if self.test_client_idx is not None:
+            test_local = {
+                c: batches(self.test_x[ix], self.test_y[ix])
+                for c, ix in self.test_client_idx.items()
+            }
+        else:
+            test_local = {c: batches(self.test_x, self.test_y)
+                          for c in self.train_client_idx}
+        return (
+            self.train_data_num,
+            self.test_data_num,
+            batches(self.train_x, self.train_y),
+            batches(self.test_x, self.test_y),
+            train_local_num,
+            train_local,
+            test_local,
+            self.num_classes,
+        )
+
+
+@dataclasses.dataclass
+class ClientBatches:
+    """Fixed-shape device-ready pack of K clients' local training data.
+
+    x:    [K, steps, batch, ...feature]
+    y:    [K, steps, batch]
+    mask: [K, steps, batch]  1.0 for a real sample, 0.0 for a wrapped pad
+    num_samples: [K] true (unpadded) per-client sample counts
+    """
+
+    x: Array
+    y: Array
+    mask: Array
+    num_samples: Array
+
+    @property
+    def num_clients(self) -> int:
+        return int(self.x.shape[0])
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return int(self.x.shape[1])
+
+    @property
+    def batch_size(self) -> int:
+        return int(self.x.shape[2])
+
+
+def pack_clients(
+    dataset: FedDataset,
+    client_ids: Sequence[int],
+    batch_size: int,
+    *,
+    steps_per_epoch: Optional[int] = None,
+    seed: int = 0,
+) -> ClientBatches:
+    """Pack the named clients' train shards into one fixed-shape block.
+
+    Heterogeneous client sizes (the SPMD hard part — SURVEY.md §7) are
+    resolved by wrapping indices (np.resize) up to a common
+    ``steps_per_epoch * batch_size`` length; the mask marks only the first
+    ``n_c`` slots per client as real.  Wrapped duplicates keep BatchNorm
+    inputs realistic while contributing zero loss/weight.
+    """
+    counts = [len(dataset.train_client_idx[c]) for c in client_ids]
+    if steps_per_epoch is None:
+        steps_per_epoch = max(1, int(np.ceil(max(max(counts), 1) / batch_size)))
+    total = steps_per_epoch * batch_size
+
+    rng = np.random.RandomState(seed)
+    xs, ys, ms, ns = [], [], [], []
+    feat_shape = dataset.train_x.shape[1:]
+    for c in client_ids:
+        idx = np.asarray(dataset.train_client_idx[c])
+        n = len(idx)
+        if n == 0:
+            # an empty client contributes nothing; fill with sample 0, mask 0
+            wrapped = np.zeros(total, dtype=np.int64)
+            mask = np.zeros(total, dtype=np.float32)
+        else:
+            idx = rng.permutation(idx)
+            wrapped = np.resize(idx, total)
+            mask = np.zeros(total, dtype=np.float32)
+            mask[: min(n, total)] = 1.0
+        xs.append(dataset.train_x[wrapped].reshape(steps_per_epoch, batch_size, *feat_shape))
+        ys.append(dataset.train_y[wrapped].reshape(steps_per_epoch, batch_size))
+        ms.append(mask.reshape(steps_per_epoch, batch_size))
+        ns.append(min(n, total))
+
+    return ClientBatches(
+        x=np.stack(xs),
+        y=np.stack(ys),
+        mask=np.stack(ms),
+        num_samples=np.array(ns, dtype=np.float32),
+    )
+
+
+def batch_eval_pack(
+    x: np.ndarray, y: np.ndarray, batch_size: int
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Pad (by wrapping) an eval set to a whole number of batches.
+
+    Returns (x_batched [steps, B, ...], y_batched [steps, B], mask).
+    """
+    n = len(x)
+    steps = max(1, int(np.ceil(n / batch_size)))
+    total = steps * batch_size
+    idx = np.resize(np.arange(n), total)
+    mask = np.zeros(total, dtype=np.float32)
+    mask[:n] = 1.0
+    return (
+        x[idx].reshape(steps, batch_size, *x.shape[1:]),
+        y[idx].reshape(steps, batch_size),
+        mask.reshape(steps, batch_size),
+    )

@@ -1,0 +1,117 @@
+"""Synthetic federated datasets.
+
+Two generators:
+
+- ``synthetic_alpha_beta`` — the LEAF Synthetic(α,β) logistic-regression
+  benchmark used by the reference
+  (``fedml_api/data_preprocessing/synthetic_1_1/data_loader.py``; numbers
+  at ``benchmark/README.md:14``): per-client model w_c ~ N(u_c, 1),
+  u_c ~ N(0, α); per-client feature mean b_c ~ N(B_c, 1), B_c ~ N(0, β);
+  features x ~ N(b_c, Σ) with Σ_jj = j^{-1.2}; labels argmax(softmax(Wx+b)).
+- ``synthetic_classification`` — a generic learnable class-prototype
+  dataset used as the offline stand-in when a real dataset's files are
+  not on disk (this environment has no network egress; loaders fall back
+  to matched-shape synthetic data and say so).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from fedml_tpu.core.partition import partition_data
+from fedml_tpu.core.types import FedDataset
+
+
+def synthetic_alpha_beta(
+    alpha: float = 1.0,
+    beta: float = 1.0,
+    num_clients: int = 30,
+    dim: int = 60,
+    num_classes: int = 10,
+    seed: int = 0,
+) -> FedDataset:
+    rng = np.random.RandomState(seed)
+    samples_per_client = (
+        np.random.RandomState(seed + 1).lognormal(4, 2, num_clients).astype(int) + 50
+    )
+    diag = np.array([(j + 1) ** -1.2 for j in range(dim)])
+
+    xs, ys, owner = [], [], []
+    for c in range(num_clients):
+        u_c = rng.normal(0, alpha)
+        B_c = rng.normal(0, beta)
+        W = rng.normal(u_c, 1, (num_classes, dim))
+        b = rng.normal(u_c, 1, num_classes)
+        v_c = rng.normal(B_c, 1, dim)
+        n = int(samples_per_client[c])
+        x = rng.multivariate_normal(v_c, np.diag(diag), n).astype(np.float32)
+        y = np.argmax(x @ W.T + b, axis=1).astype(np.int32)
+        xs.append(x)
+        ys.append(y)
+        owner.extend([c] * n)
+
+    x = np.concatenate(xs)
+    y = np.concatenate(ys)
+    owner = np.array(owner)
+    n_total = len(x)
+    test_rng = np.random.RandomState(seed + 2)
+    test_mask = np.zeros(n_total, bool)
+    test_mask[test_rng.choice(n_total, n_total // 10, replace=False)] = True
+
+    train_idx_global = np.where(~test_mask)[0]
+    remap = -np.ones(n_total, np.int64)
+    remap[train_idx_global] = np.arange(len(train_idx_global))
+    client_idx = {
+        c: remap[np.where((owner == c) & ~test_mask)[0]] for c in range(num_clients)
+    }
+    return FedDataset(
+        train_x=x[~test_mask],
+        train_y=y[~test_mask],
+        test_x=x[test_mask],
+        test_y=y[test_mask],
+        train_client_idx=client_idx,
+        test_client_idx=None,
+        num_classes=num_classes,
+        name=f"synthetic_{alpha}_{beta}",
+    )
+
+
+def synthetic_classification(
+    num_train: int = 6000,
+    num_test: int = 1000,
+    input_shape=(28, 28, 1),
+    num_classes: int = 10,
+    num_clients: int = 10,
+    partition: str = "hetero",
+    partition_alpha: float = 0.5,
+    noise: float = 0.8,
+    seed: int = 0,
+    name: str = "synthetic",
+) -> FedDataset:
+    """Class-prototype Gaussian data with the same shapes as a real dataset."""
+    rng = np.random.RandomState(seed)
+    protos = rng.normal(0, 1, (num_classes, *input_shape)).astype(np.float32)
+
+    def make(n, sd):
+        r = np.random.RandomState(sd)
+        y = r.randint(0, num_classes, n).astype(np.int32)
+        x = protos[y] + r.normal(0, noise, (n, *input_shape)).astype(np.float32)
+        return x.astype(np.float32), y
+
+    train_x, train_y = make(num_train, seed + 10)
+    test_x, test_y = make(num_test, seed + 11)
+    client_idx = partition_data(
+        train_y, num_clients, partition, partition_alpha, seed=seed
+    )
+    return FedDataset(
+        train_x=train_x,
+        train_y=train_y,
+        test_x=test_x,
+        test_y=test_y,
+        train_client_idx=client_idx,
+        test_client_idx=None,
+        num_classes=num_classes,
+        name=name,
+    )

@@ -1,0 +1,72 @@
+"""FedAvg benchmark CNNs (reference: ``fedml_api/model/cv/cnn.py``).
+
+- ``CNNOriginalFedAvg`` — the McMahan et al. 2016 MNIST/FEMNIST CNN
+  (reference ``cnn.py:5-70``): 2×[5×5 conv → 2×2 maxpool] → dense 512 →
+  softmax head.
+- ``CNNDropOut`` — the TFF baseline variant with dropout
+  (reference ``cnn.py:72-146``): 2×[3×3 conv] → maxpool → dropout 0.25 →
+  dense 128 → dropout 0.5 → head.
+
+NHWC layout throughout (TPU-native; torch reference is NCHW).
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+
+
+class CNNOriginalFedAvg(nn.Module):
+    num_classes: int = 62
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:  # flat 784 input
+            side = int(x.shape[-1] ** 0.5)
+            x = x.reshape((x.shape[0], side, side, 1))
+        x = nn.Conv(32, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(64, (5, 5), padding="SAME")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(10 if self.only_digits else self.num_classes)(x)
+
+
+class CNNDropOut(nn.Module):
+    num_classes: int = 62
+    only_digits: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:
+            side = int(x.shape[-1] ** 0.5)
+            x = x.reshape((x.shape[0], side, side, 1))
+        x = nn.relu(nn.Conv(32, (3, 3), padding="VALID")(x))
+        x = nn.relu(nn.Conv(64, (3, 3), padding="VALID")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Dropout(0.25, deterministic=not train)(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(128)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(10 if self.only_digits else self.num_classes)(x)
+
+
+def cnn_original_fedavg(only_digits: bool = True, side: int = 28) -> ModelBundle:
+    return ModelBundle(
+        module=CNNOriginalFedAvg(only_digits=only_digits),
+        input_shape=(side, side, 1),
+    )
+
+
+def cnn_dropout(only_digits: bool = False, side: int = 28) -> ModelBundle:
+    return ModelBundle(
+        module=CNNDropOut(only_digits=only_digits),
+        input_shape=(side, side, 1),
+        needs_dropout_rng=True,
+    )

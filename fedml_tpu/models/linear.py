@@ -1,0 +1,32 @@
+"""Linear models (reference: ``fedml_api/model/linear/lr.py:4-11``)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+
+
+class LogisticRegression(nn.Module):
+    """Single dense layer over flattened input; logits out.
+
+    The reference applies an explicit sigmoid (``lr.py:10``) and then
+    trains with CrossEntropyLoss anyway; we emit raw logits and keep the
+    softmax inside the loss, which is the numerically sane reading of the
+    same model.
+    """
+
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def logistic_regression(input_dim: int = 784, num_classes: int = 10) -> ModelBundle:
+    return ModelBundle(
+        module=LogisticRegression(num_classes=num_classes),
+        input_shape=(input_dim,),
+    )

@@ -1,0 +1,124 @@
+"""CIFAR ResNets with BatchNorm (reference ``fedml_api/model/cv/resnet.py``).
+
+Architecture parity with the reference (``resnet.py:112-230``): 3×3/16
+CIFAR stem, three stages at 16/32/64 planes with stride-2 transitions,
+global average pool, linear head.  The reference's ``resnet56``/
+``resnet110`` factories use **Bottleneck** blocks with [6,6,6]/[12,12,12]
+(``resnet.py:202-244``) — matched here, plus BasicBlock variants.
+
+TPU-first choices: NHWC layout, flax BatchNorm with explicit
+``batch_stats`` collection (FedAvg averages them, matching reference
+behavior — SURVEY.md §7 BN note), optional ``KD=True`` feature output
+used by FedGKT-style distillation (``resnet.py:188-199``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+
+ModuleDef = Any
+
+
+def _norm(train: bool, name=None):
+    return nn.BatchNorm(
+        use_running_average=not train, momentum=0.9, epsilon=1e-5, name=name
+    )
+
+
+class BasicBlock(nn.Module):
+    planes: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        identity = x
+        y = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1, use_bias=False)(x)
+        y = _norm(train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False)(y)
+        y = _norm(train)(y)
+        if identity.shape != y.shape:
+            identity = nn.Conv(
+                self.planes, (1, 1), strides=self.stride, use_bias=False
+            )(x)
+            identity = _norm(train)(identity)
+        return nn.relu(y + identity)
+
+
+class Bottleneck(nn.Module):
+    planes: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out_ch = self.planes * self.expansion
+        identity = x
+        y = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
+        y = _norm(train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1, use_bias=False)(y)
+        y = _norm(train)(y)
+        y = nn.relu(y)
+        y = nn.Conv(out_ch, (1, 1), use_bias=False)(y)
+        y = _norm(train)(y)
+        if identity.shape != y.shape:
+            identity = nn.Conv(out_ch, (1, 1), strides=self.stride, use_bias=False)(x)
+            identity = _norm(train)(identity)
+        return nn.relu(y + identity)
+
+
+class CifarResNet(nn.Module):
+    block: Callable
+    layers: Sequence[int]
+    num_classes: int = 10
+    return_features: bool = False  # the reference's KD flag
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False)(x)
+        x = _norm(train)(x)
+        x = nn.relu(x)
+        for stage, (planes, n_blocks) in enumerate(zip((16, 32, 64), self.layers)):
+            for i in range(n_blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = self.block(planes=planes, stride=stride)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.num_classes)(x)
+        if self.return_features:
+            return x, logits
+        return logits
+
+
+def _bundle(block, layers, num_classes, image_size=32):
+    return ModelBundle(
+        module=CifarResNet(block=block, layers=layers, num_classes=num_classes),
+        input_shape=(image_size, image_size, 3),
+    )
+
+
+def resnet20(num_classes=10, **kw):
+    return _bundle(BasicBlock, (3, 3, 3), num_classes, **kw)
+
+
+def resnet32(num_classes=10, **kw):
+    return _bundle(BasicBlock, (5, 5, 5), num_classes, **kw)
+
+
+def resnet44(num_classes=10, **kw):
+    return _bundle(BasicBlock, (7, 7, 7), num_classes, **kw)
+
+
+def resnet56(num_classes=10, **kw):
+    """Reference factory: ResNet(Bottleneck, [6,6,6]) (``resnet.py:202-209``)."""
+    return _bundle(Bottleneck, (6, 6, 6), num_classes, **kw)
+
+
+def resnet110(num_classes=10, **kw):
+    """Reference factory: ResNet(Bottleneck, [12,12,12]) (``resnet.py:225-232``)."""
+    return _bundle(Bottleneck, (12, 12, 12), num_classes, **kw)

@@ -1,0 +1,121 @@
+"""ImageNet-style ResNets with GroupNorm
+(reference ``fedml_api/model/cv/resnet_gn.py`` + ``group_normalization.py``).
+
+GroupNorm replaces BatchNorm so there is no non-averageable running
+state — the benchmark model for fed_CIFAR100 (``resnet18_gn`` at
+``main_fedavg.py:229-231``; target 44.7 acc, ``benchmark/README.md:55``).
+The reference parameterizes ``num_channels_per_group=32``
+(``resnet_gn.py:26-34``); flax ``nn.GroupNorm`` takes group count, so we
+convert per-layer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+
+
+def _gn(channels: int, channels_per_group: int = 32):
+    groups = max(1, channels // channels_per_group)
+    return nn.GroupNorm(num_groups=groups, epsilon=1e-5)
+
+
+class BasicBlockGN(nn.Module):
+    planes: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        identity = x
+        y = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1, use_bias=False)(x)
+        y = _gn(self.planes)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), padding=1, use_bias=False)(y)
+        y = _gn(self.planes)(y)
+        if identity.shape != y.shape:
+            identity = nn.Conv(self.planes, (1, 1), strides=self.stride, use_bias=False)(x)
+            identity = _gn(self.planes)(identity)
+        return nn.relu(y + identity)
+
+
+class BottleneckGN(nn.Module):
+    planes: int
+    stride: int = 1
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        out_ch = self.planes * self.expansion
+        identity = x
+        y = nn.Conv(self.planes, (1, 1), use_bias=False)(x)
+        y = _gn(self.planes)(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.planes, (3, 3), strides=self.stride, padding=1, use_bias=False)(y)
+        y = _gn(self.planes)(y)
+        y = nn.relu(y)
+        y = nn.Conv(out_ch, (1, 1), use_bias=False)(y)
+        y = _gn(out_ch)(y)
+        if identity.shape != y.shape:
+            identity = nn.Conv(out_ch, (1, 1), strides=self.stride, use_bias=False)(x)
+            identity = _gn(out_ch)(identity)
+        return nn.relu(y + identity)
+
+
+class ResNetGN(nn.Module):
+    block: Callable
+    layers: Sequence[int]
+    num_classes: int = 100
+    small_input: bool = True  # 32×32 federated images: 3×3 stem, no maxpool
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.small_input:
+            x = nn.Conv(64, (3, 3), padding=1, use_bias=False)(x)
+            x = _gn(64)(x)
+            x = nn.relu(x)
+        else:
+            x = nn.Conv(64, (7, 7), strides=2, padding=3, use_bias=False)(x)
+            x = _gn(64)(x)
+            x = nn.relu(x)
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding=((1, 1), (1, 1)))
+        for stage, (planes, n_blocks) in enumerate(
+            zip((64, 128, 256, 512), self.layers)
+        ):
+            for i in range(n_blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = self.block(planes=planes, stride=stride)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def _bundle(block, layers, num_classes, image_size=32, small_input=True):
+    return ModelBundle(
+        module=ResNetGN(
+            block=block, layers=layers, num_classes=num_classes, small_input=small_input
+        ),
+        input_shape=(image_size, image_size, 3),
+    )
+
+
+def resnet18_gn(num_classes=100, **kw):
+    return _bundle(BasicBlockGN, (2, 2, 2, 2), num_classes, **kw)
+
+
+def resnet34_gn(num_classes=100, **kw):
+    return _bundle(BasicBlockGN, (3, 4, 6, 3), num_classes, **kw)
+
+
+def resnet50_gn(num_classes=100, **kw):
+    return _bundle(BottleneckGN, (3, 4, 6, 3), num_classes, **kw)
+
+
+def resnet101_gn(num_classes=100, **kw):
+    return _bundle(BottleneckGN, (3, 4, 23, 3), num_classes, **kw)
+
+
+def resnet152_gn(num_classes=100, **kw):
+    return _bundle(BottleneckGN, (3, 8, 36, 3), num_classes, **kw)

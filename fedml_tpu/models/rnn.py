@@ -1,0 +1,69 @@
+"""Federated language models (reference ``fedml_api/model/nlp/rnn.py``).
+
+- ``RNNOriginalFedAvg`` — McMahan et al. Shakespeare next-char model
+  (``rnn.py:4-37``): embed(90→8) → 2-layer LSTM(256) → dense(vocab),
+  predicting from the final hidden state.
+- ``RNNStackOverflow`` — Adaptive Federated Optimization Table 9 NWP
+  model (``rnn.py:39-77``): embed(10004→96) → 1-layer LSTM(670) →
+  dense 96 → dense vocab, per-position logits.
+
+TPU-first: LSTMs run as ``nn.RNN`` (lax.scan over an LSTMCell) in
+bfloat16-friendly f32; sequences are fixed-length so everything jits
+statically.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+
+
+class RNNOriginalFedAvg(nn.Module):
+    vocab_size: int = 90
+    embedding_dim: int = 8
+    hidden_size: int = 256
+    seq_output: bool = False  # fed_shakespeare variant: logits at every step
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = nn.Embed(self.vocab_size, self.embedding_dim)(x.astype(jnp.int32))
+        h = nn.RNN(nn.LSTMCell(self.hidden_size))(h)
+        h = nn.RNN(nn.LSTMCell(self.hidden_size))(h)
+        if self.seq_output:
+            return nn.Dense(self.vocab_size)(h)  # [B, T, V]
+        return nn.Dense(self.vocab_size)(h[:, -1])  # [B, V]
+
+
+class RNNStackOverflow(nn.Module):
+    vocab_size: int = 10000
+    num_oov_buckets: int = 1
+    embedding_size: int = 96
+    latent_size: int = 670
+    num_layers: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        extended = self.vocab_size + 3 + self.num_oov_buckets  # pad/bos/eos/oov
+        h = nn.Embed(extended, self.embedding_size)(x.astype(jnp.int32))
+        for _ in range(self.num_layers):
+            h = nn.RNN(nn.LSTMCell(self.latent_size))(h)
+        h = nn.Dense(self.embedding_size)(h)
+        return nn.Dense(extended)(h)  # [B, T, V]
+
+
+def rnn_shakespeare(seq_len: int = 80, vocab_size: int = 90, seq_output: bool = False):
+    return ModelBundle(
+        module=RNNOriginalFedAvg(vocab_size=vocab_size, seq_output=seq_output),
+        input_shape=(seq_len,),
+        input_dtype=jnp.int32,
+    )
+
+
+def rnn_stackoverflow(seq_len: int = 20, vocab_size: int = 10000):
+    return ModelBundle(
+        module=RNNStackOverflow(vocab_size=vocab_size),
+        input_shape=(seq_len,),
+        input_dtype=jnp.int32,
+    )

@@ -1,0 +1,97 @@
+"""SPMD execution of federated rounds over a device mesh.
+
+This is the ComManager replacement the BASELINE.json north star names:
+the reference's one-MPI-process-per-participant layout
+(``FedAvgAPI.py:10-25`` + ``run_fedavg_distributed_pytorch.sh:19-23``)
+becomes one SPMD program on a ``clients`` mesh axis.  Model sync is
+replication (no explicit broadcast messages); upload + aggregate is a
+masked weighted ``lax.psum``; subsampling is a collective mask.  A
+``model`` axis is reserved in the mesh so tensor/pipeline extensions
+don't force a redesign (SURVEY.md §2.6).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from fedml_tpu.algorithms.fedavg import ServerState, make_round_fn
+from fedml_tpu.core.client import LocalUpdateFn
+
+PyTree = Any
+
+
+def make_client_mesh(
+    num_devices: Optional[int] = None, *, model_axis: int = 1, devices=None
+) -> Mesh:
+    """Mesh with a ``clients`` data axis and a reserved ``model`` axis."""
+    devices = devices if devices is not None else jax.devices()
+    if num_devices is not None:
+        devices = devices[:num_devices]
+    n = len(devices)
+    assert n % model_axis == 0
+    arr = np.array(devices).reshape(n // model_axis, model_axis)
+    return Mesh(arr, axis_names=("clients", "model"))
+
+
+def make_spmd_round_fn(
+    mesh: Mesh,
+    local_update: LocalUpdateFn,
+    *,
+    server_update=None,
+    aggregate_transform=None,
+    donate: bool = True,
+):
+    """shard_map the round over the ``clients`` mesh axis.
+
+    Data layout: the packed client block [C, steps, B, ...] is sharded on
+    its leading axis; each device vmaps over its local C/D clients, then
+    the weighted tree-sums are psum'd across the axis.  Server state is
+    fully replicated, so the returned new state is identical on every
+    device — broadcast of the next round's model is free.
+    """
+    kwargs = {}
+    if server_update is not None:
+        kwargs["server_update"] = server_update
+    inner = make_round_fn(
+        local_update,
+        aggregate_transform=aggregate_transform,
+        axis_name="clients",
+        **kwargs,
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(),  # state replicated
+            P("clients"),  # x
+            P("clients"),  # y
+            P("clients"),  # mask
+            P("clients"),  # num_samples
+            P("clients"),  # participation
+            P("clients"),  # global slot ids
+        ),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def spmd_round(state, x, y, mask, num_samples, participation, slot_ids):
+        return inner(state, x, y, mask, num_samples, participation, slot_ids)
+
+    return jax.jit(spmd_round, donate_argnums=(0,) if donate else ())
+
+
+def shard_client_block(mesh: Mesh, pack_arrays):
+    """device_put packed [C, ...] arrays sharded over the clients axis."""
+    sharding = NamedSharding(mesh, P("clients"))
+    return tuple(jax.device_put(jnp.asarray(a), sharding) for a in pack_arrays)
+
+
+def replicate(mesh: Mesh, tree: PyTree) -> PyTree:
+    sharding = NamedSharding(mesh, P())
+    return jax.device_put(tree, sharding)

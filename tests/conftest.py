@@ -1,0 +1,27 @@
+"""Test harness: multi-client without a cluster.
+
+The reference fakes a cluster with `mpirun -np N` on localhost
+(SURVEY.md §4.4); here an 8-device CPU mesh is faked via XLA host
+devices.  Note: this environment's sitecustomize imports jax at
+interpreter startup with JAX_PLATFORMS=axon (TPU), so env mutation is
+too late — we must override via jax.config before the backend
+initializes (it is created lazily at the first device query).
+"""
+
+import os
+
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_default_matmul_precision", "highest")
+
+assert jax.device_count() >= 8, (
+    "test harness expected a faked 8-device CPU mesh; got "
+    f"{jax.device_count()} {jax.devices()[:2]}"
+)

@@ -1,0 +1,78 @@
+"""Unit tests for pytree utils, sampling masks, and client packing."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core import tree as treelib
+from fedml_tpu.core.sampling import participation_mask, sample_clients
+from fedml_tpu.core.types import FedDataset, batch_eval_pack, pack_clients
+from fedml_tpu.data.synthetic import synthetic_classification
+
+
+def test_tree_ravel_roundtrip():
+    tree = {"a": jnp.arange(6, dtype=jnp.float32).reshape(2, 3), "b": jnp.ones(4)}
+    vec = treelib.tree_ravel(tree)
+    assert vec.shape == (10,)
+    back = treelib.tree_unravel(tree, vec)
+    for k in tree:
+        np.testing.assert_allclose(back[k], tree[k])
+
+
+def test_tree_weighted_sum():
+    t1 = {"w": jnp.ones(3)}
+    t2 = {"w": 2 * jnp.ones(3)}
+    out = treelib.tree_weighted_sum([t1, t2], [0.25, 0.75])
+    np.testing.assert_allclose(out["w"], 1.75 * np.ones(3))
+
+
+def test_sampling_deterministic_and_distinct():
+    key = jax.random.PRNGKey(0)
+    ids1 = sample_clients(key, 3, 100, 10)
+    ids2 = sample_clients(key, 3, 100, 10)
+    np.testing.assert_array_equal(ids1, ids2)
+    assert len(np.unique(np.asarray(ids1))) == 10
+    ids3 = sample_clients(key, 4, 100, 10)
+    assert not np.array_equal(np.asarray(ids1), np.asarray(ids3))
+
+
+def test_participation_mask_counts():
+    key = jax.random.PRNGKey(1)
+    m = participation_mask(key, 0, 50, 7)
+    assert float(m.sum()) == 7.0
+    m_all = participation_mask(key, 0, 8, 8)
+    assert float(m_all.sum()) == 8.0
+
+
+def test_pack_clients_shapes_and_mask():
+    ds = synthetic_classification(
+        num_train=330, num_test=50, input_shape=(4,), num_clients=3,
+        partition="homo", seed=0,
+    )
+    pack = pack_clients(ds, [0, 1, 2], batch_size=16)
+    assert pack.x.shape[0] == 3
+    assert pack.x.shape[2] == 16
+    counts = ds.client_sample_counts()
+    np.testing.assert_allclose(pack.num_samples, counts.astype(np.float32))
+    np.testing.assert_allclose(pack.mask.sum(axis=(1, 2)), counts.astype(np.float32))
+
+
+def test_batch_eval_pack_masks_padding():
+    x = np.arange(10, dtype=np.float32).reshape(10, 1)
+    y = np.arange(10)
+    bx, by, bm = batch_eval_pack(x, y, 4)
+    assert bx.shape == (3, 4, 1)
+    assert bm.sum() == 10
+
+
+def test_legacy_tuple_contract():
+    ds = synthetic_classification(
+        num_train=100, num_test=20, input_shape=(4,), num_clients=5,
+        partition="homo", seed=0,
+    )
+    t = ds.legacy_tuple(batch_size=10)
+    assert len(t) == 8
+    (tr_n, te_n, tr_g, te_g, local_num, tr_l, te_l, ncls) = t
+    assert tr_n == 100 and te_n == 20 and ncls == 10
+    assert sum(local_num.values()) == 100
+    assert len(tr_l) == 5

@@ -1,0 +1,65 @@
+"""Partitioner unit tests — coverage the reference lacks (SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.partition import (
+    dirichlet_partition,
+    homo_partition,
+    partition_data,
+    powerlaw_partition,
+    record_data_stats,
+)
+
+
+def _labels(n=1000, classes=10, seed=0):
+    return np.random.RandomState(seed).randint(0, classes, n)
+
+
+def test_homo_covers_all_exactly_once():
+    parts = homo_partition(1000, 7, seed=1)
+    allidx = np.concatenate(list(parts.values()))
+    assert len(allidx) == 1000
+    assert len(np.unique(allidx)) == 1000
+
+
+def test_dirichlet_covers_all_and_min_size():
+    y = _labels()
+    parts = dirichlet_partition(y, 10, alpha=0.5, min_size_bound=10, seed=0)
+    allidx = np.concatenate(list(parts.values()))
+    assert sorted(allidx.tolist()) == list(range(1000))
+    assert min(len(v) for v in parts.values()) >= 10
+
+
+def test_dirichlet_is_noniid():
+    y = _labels(5000)
+    parts = dirichlet_partition(y, 10, alpha=0.1, seed=0)
+    stats = record_data_stats(y, parts, 10)
+    # at alpha=0.1 at least one client must be visibly skewed (missing classes)
+    assert any(len(s) < 10 for s in stats.values())
+
+
+def test_dirichlet_deterministic():
+    y = _labels()
+    a = dirichlet_partition(y, 5, alpha=0.5, seed=3)
+    b = dirichlet_partition(y, 5, alpha=0.5, seed=3)
+    for c in a:
+        np.testing.assert_array_equal(a[c], b[c])
+
+
+def test_powerlaw_sizes_skewed_and_disjoint():
+    y = _labels(20000)
+    parts = powerlaw_partition(y, 50, seed=0)
+    sizes = np.array([len(v) for v in parts.values()])
+    assert sizes.min() >= 10
+    assert sizes.max() > 2 * np.median(sizes)  # heavy tail
+    allidx = np.concatenate(list(parts.values()))
+    assert len(np.unique(allidx)) == len(allidx)
+
+
+def test_partition_dispatch():
+    y = _labels(200)
+    assert len(partition_data(y, 4, "homo")) == 4
+    assert len(partition_data(y, 4, "hetero", alpha=100.0)) == 4
+    with pytest.raises(ValueError):
+        partition_data(y, 4, "nope")

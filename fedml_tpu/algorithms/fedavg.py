@@ -69,17 +69,22 @@ def make_round_fn(
     When ``axis_name`` is set the weighted sums are additionally psum'd
     across the device mesh (SPMD full-resident mode).
 
-    ``aggregate_transform(old_variables, stacked_client_variables, weights)
-    -> stacked_client_variables`` is the hook robust aggregation plugs
-    into (norm clipping happens per-client before the sum).
+    ``aggregate_transform(old_variables, stacked_client_variables,
+    weights, rng) -> stacked_client_variables`` is the hook robust
+    aggregation plugs into (norm clipping / weak-DP noise run per-client
+    before the sum, inside the same compiled program).
     """
 
     def round_fn(state: ServerState, x, y, mask, num_samples, participation, slot_ids):
         # slot_ids are GLOBAL client slot indices — under shard_map each
         # device sees only its local block, so a local arange would collide
-        # RNG streams across devices.
+        # RNG streams across devices.  Two independent sub-streams per
+        # round (training vs aggregation noise) so per-client keys never
+        # collide across uses.
         k_round = jax.random.fold_in(state.key, state.round_idx)
-        client_rngs = jax.vmap(lambda i: jax.random.fold_in(k_round, i))(slot_ids)
+        k_train = jax.random.fold_in(k_round, 0)
+        k_agg = jax.random.fold_in(k_round, 1)
+        client_rngs = jax.vmap(lambda i: jax.random.fold_in(k_train, i))(slot_ids)
         # Model sync = SPMD replication (no explicit send).  Client-axis
         # mapping: sequential lax.map keeps each client's convs at full
         # MXU tile sizes (measured ~7x faster than vmap for ResNet-56 on
@@ -94,7 +99,13 @@ def make_round_fn(
 
         weights = participation * num_samples  # sample-weighted, masked
         if aggregate_transform is not None:
-            client_vars = aggregate_transform(state.variables, client_vars, weights)
+            # per-client keys from GLOBAL slot ids: independent noise per
+            # client even under shard_map (a single replicated key would
+            # stamp identical noise on every device's local block)
+            agg_rngs = jax.vmap(lambda i: jax.random.fold_in(k_agg, i))(slot_ids)
+            client_vars = aggregate_transform(
+                state.variables, client_vars, weights, agg_rngs
+            )
 
         num = jax.tree_util.tree_map(
             lambda leaf: jnp.einsum(
@@ -188,13 +199,9 @@ class FedAvgSimulation:
             loss_fn,
             prox_mu=config.prox_mu,
         )
-        self.round_fn = jax.jit(
-            make_round_fn(
-                self.local_update,
-                server_update=server_update,
-                aggregate_transform=aggregate_transform,
-            )
-        )
+        self._server_update = server_update
+        self._aggregate_transform = aggregate_transform
+        self.round_fn = jax.jit(self._build_round_fn())
         self.evaluator = make_evaluator(bundle, loss_fn)
 
         key = jax.random.PRNGKey(config.seed)
@@ -215,6 +222,18 @@ class FedAvgSimulation:
             dataset.test_x, dataset.test_y, max(config.batch_size, 64)
         )
         self.history = []
+
+    def _build_round_fn(self):
+        """Subclass hook: FedNova etc. swap in a different round kernel."""
+        return make_round_fn(
+            self.local_update,
+            server_update=self._server_update,
+            aggregate_transform=self._aggregate_transform,
+        )
+
+    def _extra_eval(self) -> dict:
+        """Subclass hook: extra metrics at eval rounds (e.g. backdoor acc)."""
+        return {}
 
     def _sample_ids(self, round_idx: int) -> np.ndarray:
         cfg = self.cfg
@@ -274,6 +293,7 @@ class FedAvgSimulation:
                 or r == self.cfg.comm_rounds - 1
             ):
                 metrics.update(self.evaluate_global())
+                metrics.update(self._extra_eval())
             self.history.append(metrics)
             if log_fn:
                 log_fn(metrics)

@@ -1,0 +1,188 @@
+"""FedNova — normalized averaging of heterogeneous local updates.
+
+Reference (``fedml_api/standalone/fednova/fednova.py:79-155`` custom
+optimizer + ``fednova_trainer.py:97-123`` server update): each client
+accumulates a normalized gradient d_i = cum_grad / a_i where a_i is the
+momentum-aware step-count coefficient, and the server applies
+``w ← w − τ_eff · Σ p_i d_i`` with τ_eff = Σ p_i a_i and optional global
+momentum (gmf).
+
+TPU-native formulation: instead of threading a custom optimizer through
+the client loop, d_i is recovered in closed form from the local delta —
+for SGD(+momentum ρ, lr η) over τ_i effective steps,
+``w_0 − w_τ = η · a_i · d_i`` with
+
+    a_i = τ_i                                 (ρ = 0)
+    a_i = (τ_i − ρ(1−ρ^τ_i)/(1−ρ)) / (1−ρ)    (ρ > 0)
+
+τ_i is EXACT per client: the local-update operator reports the number of
+optimizer steps it actually executed (pad-only batches are no-ops and do
+not count, even after per-epoch shuffling redistributes real samples
+across batches — ``core.client`` metrics["steps"]).
+
+The closed form is only valid for vanilla SGD(+momentum): gradient
+clipping or weight decay would break ``w_0 − w_τ = η a_i d_i``, so those
+config knobs are rejected.
+
+The whole round — local scans, per-client normalization, weighted
+reduce, server momentum — is one compiled program, psum-ready on the
+``clients`` mesh axis.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.algorithms.fedavg import (
+    FedAvgConfig,
+    FedAvgSimulation,
+    ServerState,
+)
+from fedml_tpu.core import tree as treelib
+from fedml_tpu.core.losses import LossFn, masked_softmax_ce
+from fedml_tpu.core.types import FedDataset
+from fedml_tpu.models.base import ModelBundle
+
+PyTree = Any
+
+
+def nova_coefficient(tau, rho: float):
+    """a_i for SGD(+momentum) — Wang et al. 2020, momentum case."""
+    tau = tau.astype(jnp.float32)
+    if rho == 0.0:
+        return jnp.maximum(tau, 1.0)
+    geom = (1.0 - rho**tau) / (1.0 - rho)
+    return jnp.maximum((tau - rho * geom) / (1.0 - rho), 1.0)
+
+
+def make_fednova_round_fn(
+    local_update,
+    *,
+    lr: float,
+    momentum: float,
+    gmf: float = 0.0,
+    axis_name: Optional[str] = None,
+    client_axis_impl: str = "map",
+):
+    def round_fn(state: ServerState, x, y, mask, num_samples, participation, slot_ids):
+        k_round = jax.random.fold_in(state.key, state.round_idx)
+        k_train = jax.random.fold_in(k_round, 0)
+        client_rngs = jax.vmap(lambda i: jax.random.fold_in(k_train, i))(slot_ids)
+        w0 = state.variables["params"]
+
+        run_one = lambda cx, cy, cm, ck: local_update(state.variables, cx, cy, cm, ck)
+        if client_axis_impl == "vmap":
+            client_vars, client_metrics = jax.vmap(run_one)(x, y, mask, client_rngs)
+        else:
+            client_vars, client_metrics = jax.lax.map(
+                lambda a: run_one(*a), (x, y, mask, client_rngs)
+            )
+
+        tau = client_metrics["steps"]  # [K] exact executed optimizer steps
+        a = nova_coefficient(tau, momentum)  # [K]
+
+        weights = participation * num_samples
+        total = weights.sum()
+        if axis_name is not None:
+            total = jax.lax.psum(total, axis_name)
+        p = weights / jnp.maximum(total, 1e-12)  # [K], sums to 1 globally
+
+        # d_i = (w0 − w_i) / (lr · a_i); Σ p_i d_i and τ_eff = Σ p_i a_i
+        d_sum = jax.tree_util.tree_map(
+            lambda w0_l, wi_l: jnp.einsum(
+                "k,k...->...",
+                p / (lr * a),
+                w0_l[None].astype(jnp.float32) - wi_l.astype(jnp.float32),
+            ),
+            w0,
+            client_vars["params"],
+        )
+        tau_eff = (p * a).sum()
+        if axis_name is not None:
+            d_sum = jax.lax.psum(d_sum, axis_name)
+            tau_eff = jax.lax.psum(tau_eff, axis_name)
+
+        if gmf > 0.0:
+            buf = treelib.tree_add(treelib.tree_scale(state.opt_state, gmf), d_sum)
+            step_dir = buf
+            new_opt_state = buf
+        else:
+            step_dir = d_sum
+            new_opt_state = state.opt_state
+
+        new_params = jax.tree_util.tree_map(
+            lambda w, d: (w.astype(jnp.float32) - tau_eff * lr * d).astype(w.dtype),
+            w0,
+            step_dir,
+        )
+        new_vars = {**state.variables, "params": new_params}
+        # non-param collections (e.g. batch_stats): plain weighted average
+        for coll in state.variables:
+            if coll == "params":
+                continue
+            summed = jax.tree_util.tree_map(
+                lambda leaf: jnp.einsum("k,k...->...", p, leaf.astype(jnp.float32)),
+                client_vars[coll],
+            )
+            if axis_name is not None:
+                summed = jax.lax.psum(summed, axis_name)
+            new_vars[coll] = jax.tree_util.tree_map(
+                lambda s, ref: s.astype(ref.dtype), summed, state.variables[coll]
+            )
+
+        train_metrics = {
+            k: (
+                jax.lax.psum((participation * v).sum(), axis_name)
+                if axis_name
+                else (participation * v).sum()
+            )
+            for k, v in client_metrics.items()
+        }
+        new_state = ServerState(
+            variables=new_vars,
+            opt_state=new_opt_state,
+            round_idx=state.round_idx + 1,
+            key=state.key,
+        )
+        return new_state, train_metrics
+
+    return round_fn
+
+
+class FedNovaSimulation(FedAvgSimulation):
+    """Standalone FedNova driver (reference ``standalone/fednova/``),
+    sharing the FedAvg simulation loop; only the round kernel differs."""
+
+    def __init__(
+        self,
+        bundle: ModelBundle,
+        dataset: FedDataset,
+        config: FedAvgConfig,
+        *,
+        gmf: float = 0.0,
+        loss_fn: LossFn = masked_softmax_ce,
+    ):
+        if config.client_optimizer != "sgd":
+            raise ValueError("FedNova requires the SGD client optimizer")
+        if config.grad_clip is not None or config.weight_decay:
+            raise ValueError(
+                "FedNova's closed-form normalization assumes vanilla "
+                "SGD(+momentum); grad_clip/weight_decay are unsupported"
+            )
+        self._gmf = gmf
+        super().__init__(bundle, dataset, config, loss_fn=loss_fn)
+        if gmf > 0.0:
+            self.state = self.state._replace(
+                opt_state=treelib.tree_zeros_like(self.state.variables["params"])
+            )
+
+    def _build_round_fn(self):
+        return make_fednova_round_fn(
+            self.local_update,
+            lr=self.cfg.lr,
+            momentum=self.cfg.momentum,
+            gmf=self._gmf,
+        )

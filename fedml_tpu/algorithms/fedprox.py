@@ -1,0 +1,49 @@
+"""FedProx — FedAvg plus a proximal term on the client objective.
+
+Reference (``fedml_api/distributed/fedprox/MyModelTrainer.py:41-60``):
+client loss += (mu/2)·‖w − w_global‖²; aggregation is identical to
+FedAvg.  Here the proximal term is a flag on the shared local-update
+operator (``fedml_tpu.core.client.make_local_update(prox_mu=...)``)
+computed over parameters only — the reference's parameter/buffer index
+misalignment (SURVEY.md §7 known defects) is not replicated.
+
+FedProx also supports preprocessed client-sampling lists
+(``FedProxAPI.py:19-60``); pass ``sampling_schedule`` to override the
+per-round seeded uniform sampling.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig, FedAvgSimulation
+from fedml_tpu.core.losses import LossFn, masked_softmax_ce
+from fedml_tpu.core.types import FedDataset
+from fedml_tpu.models.base import ModelBundle
+
+
+class FedProxSimulation(FedAvgSimulation):
+    def __init__(
+        self,
+        bundle: ModelBundle,
+        dataset: FedDataset,
+        config: FedAvgConfig,
+        *,
+        mu: float = 0.1,
+        sampling_schedule: Optional[Sequence[Sequence[int]]] = None,
+        loss_fn: LossFn = masked_softmax_ce,
+        **kwargs,
+    ):
+        import dataclasses
+
+        config = dataclasses.replace(config, prox_mu=mu)
+        super().__init__(bundle, dataset, config, loss_fn=loss_fn, **kwargs)
+        self._sampling_schedule = sampling_schedule
+
+    def _sample_ids(self, round_idx: int) -> np.ndarray:
+        if self._sampling_schedule is not None:
+            sched = self._sampling_schedule
+            return np.asarray(sched[round_idx % len(sched)])
+        return super()._sample_ids(round_idx)

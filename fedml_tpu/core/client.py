@@ -135,6 +135,7 @@ def make_local_update(
                     variables["params"],
                 )
                 new_vars = {**new_vars, "params": params}
+                aux = {**aux, "step": has_real}
                 return (new_vars, new_opt), aux
 
             (variables, opt_state), auxs = jax.lax.scan(
@@ -151,6 +152,9 @@ def make_local_update(
             "loss_sum": auxs["loss_sum"][-1].sum(),
             "correct": auxs["correct"][-1].sum(),
             "count": auxs["count"][-1].sum(),
+            # exact optimizer steps executed across ALL epochs (pad-only
+            # batches are no-ops and excluded) — FedNova's tau_i
+            "steps": auxs["step"].sum(),
         }
         return variables, metrics
 

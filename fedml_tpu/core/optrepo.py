@@ -1,0 +1,74 @@
+"""Server optimizer registry.
+
+Reference: ``fedml_api/distributed/fedopt/optrepo.py:7-60`` discovers
+``torch.optim`` subclasses by reflection so ``--server_optimizer`` can
+name any of them.  The TPU-native equivalent is a name → optax
+constructor registry; FedAdam/FedYogi/FedAvgM (Reddi et al., Adaptive
+Federated Optimization) come from optax transforms applied to the
+aggregated pseudo-gradient (``FedOptAggregator.set_model_global_grads``,
+``FedOptAggregator.py:110-118``).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import optax
+
+_REGISTRY: Dict[str, Callable[..., optax.GradientTransformation]] = {}
+
+
+def register(name: str):
+    def deco(fn):
+        _REGISTRY[name.lower()] = fn
+        return fn
+
+    return deco
+
+
+@register("sgd")
+def _sgd(lr: float = 1.0, momentum: float = 0.0, **kw):
+    return optax.sgd(lr, momentum=momentum if momentum else None)
+
+
+@register("avgm")
+@register("fedavgm")
+def _avgm(lr: float = 1.0, momentum: float = 0.9, **kw):
+    return optax.sgd(lr, momentum=momentum)
+
+
+@register("adam")
+@register("fedadam")
+def _adam(lr: float = 1e-2, b1: float = 0.9, b2: float = 0.99, eps: float = 1e-3, **kw):
+    # eps=1e-3 is the Adaptive-FedOpt paper default (tau)
+    return optax.adam(lr, b1=b1, b2=b2, eps=eps)
+
+
+@register("yogi")
+@register("fedyogi")
+def _yogi(lr: float = 1e-2, b1: float = 0.9, b2: float = 0.99, eps: float = 1e-3, **kw):
+    return optax.yogi(lr, b1=b1, b2=b2, eps=eps)
+
+
+@register("adagrad")
+@register("fedadagrad")
+def _adagrad(lr: float = 1e-2, eps: float = 1e-3, **kw):
+    return optax.adagrad(lr, eps=eps)
+
+
+@register("lamb")
+def _lamb(lr: float = 1e-3, **kw):
+    return optax.lamb(lr)
+
+
+def get_server_optimizer(name: str, **kwargs) -> optax.GradientTransformation:
+    try:
+        return _REGISTRY[name.lower()](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown server optimizer {name!r}; have {sorted(_REGISTRY)}"
+        ) from None
+
+
+def names():
+    return sorted(_REGISTRY)

@@ -1,0 +1,199 @@
+"""Decentralized (serverless) gossip FL.
+
+Reference ``fedml_api/distributed/decentralized_framework/``
+(``decentralized_worker_manager.py:29-46``): each worker trains locally,
+pushes its result to out-neighbors from the topology, and aggregates
+when all in-neighbors' results arrived.  The message choreography
+disappears on TPU: one gossip round is
+
+    local updates on every client's OWN model (persistent, not reset to
+    a global model)  →  mixing step  P ← W·P  with the row-stochastic
+    topology matrix W.
+
+Mixing is a single einsum over the stacked client axis (dense W), or —
+for the ring topology on an ICI ring — two ``lax.ppermute`` shifts,
+which is the sparse-neighbor-exchange design SURVEY.md §2.6 calls for.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.core.client import make_client_optimizer, make_evaluator, make_local_update
+from fedml_tpu.core.losses import LossFn, masked_softmax_ce
+from fedml_tpu.core.types import FedDataset, batch_eval_pack, pack_clients
+from fedml_tpu.models.base import ModelBundle
+
+PyTree = Any
+
+
+def dense_mix(stacked_vars: PyTree, w: jax.Array) -> PyTree:
+    """P ← W·P for every leaf with client leading axis."""
+    return jax.tree_util.tree_map(
+        lambda leaf: jnp.einsum("ij,j...->i...", w, leaf.astype(jnp.float32)).astype(
+            leaf.dtype
+        ),
+        stacked_vars,
+    )
+
+
+def ring_mix(local_vars: PyTree, axis_name: str, w_self=1 / 3, w_left=1 / 3, w_right=1 / 3):
+    """Ring mixing via two ppermutes over a mesh axis (one client/device)."""
+    n = jax.lax.axis_size(axis_name)
+    left = [(i, (i + 1) % n) for i in range(n)]
+    right = [(i, (i - 1) % n) for i in range(n)]
+    return jax.tree_util.tree_map(
+        lambda leaf: (
+            w_self * leaf.astype(jnp.float32)
+            + w_left * jax.lax.ppermute(leaf.astype(jnp.float32), axis_name, left)
+            + w_right * jax.lax.ppermute(leaf.astype(jnp.float32), axis_name, right)
+        ).astype(leaf.dtype),
+        local_vars,
+    )
+
+
+def make_gossip_round_fn(
+    local_update,
+    mixing_matrix: Optional[np.ndarray] = None,
+    *,
+    axis_name: Optional[str] = None,
+    ring: bool = False,
+):
+    """Round over stacked per-client variables [K, ...].
+
+    Simulation: dense ``mixing_matrix`` einsum.  SPMD (axis_name set,
+    one client per device): ``ring=True`` uses ppermute; otherwise the
+    dense matrix is applied via all_gather + einsum.
+    """
+    if mixing_matrix is None and not (axis_name and ring):
+        raise ValueError(
+            "make_gossip_round_fn: a mixing_matrix is required unless "
+            "using the SPMD ring path (axis_name=..., ring=True)"
+        )
+    if mixing_matrix is not None:
+        w_const = jnp.asarray(mixing_matrix, jnp.float32)
+
+    def round_fn(stacked_vars, x, y, mask, rng, slot_ids):
+        rngs = jax.vmap(lambda i: jax.random.fold_in(rng, i))(slot_ids)
+        new_vars, metrics = jax.lax.map(
+            lambda a: local_update({k: v for k, v in a[0].items()}, *a[1:]),
+            (stacked_vars, x, y, mask, rngs),
+        )
+        if axis_name is None:
+            mixed = dense_mix(new_vars, w_const)
+        elif ring:
+            # one client per device: drop the singleton pack axis, mix, restore
+            squeezed = jax.tree_util.tree_map(lambda l: l[0], new_vars)
+            mixed = ring_mix(squeezed, axis_name)
+            mixed = jax.tree_util.tree_map(lambda l: l[None], mixed)
+        else:
+            gathered = jax.tree_util.tree_map(
+                lambda l: jax.lax.all_gather(l, axis_name, tiled=True), new_vars
+            )
+            row = jax.lax.axis_index(axis_name)
+            mixed = jax.tree_util.tree_map(
+                lambda g: jnp.einsum(
+                    "j,j...->...", w_const[row], g.astype(jnp.float32)
+                ).astype(g.dtype)[None],
+                gathered,
+            )
+        metrics = {k: v.sum() for k, v in metrics.items()}
+        return mixed, metrics
+
+    return round_fn
+
+
+class DecentralizedSimulation:
+    """Single-process gossip driver (reference decentralized demo +
+    ``standalone/decentralized`` DSGD)."""
+
+    def __init__(
+        self,
+        bundle: ModelBundle,
+        dataset: FedDataset,
+        mixing_matrix: np.ndarray,
+        *,
+        epochs: int = 1,
+        batch_size: int = 20,
+        lr: float = 0.1,
+        momentum: float = 0.0,
+        loss_fn: LossFn = masked_softmax_ce,
+        seed: int = 0,
+    ):
+        self.bundle = bundle
+        self.dataset = dataset
+        self.w = np.asarray(mixing_matrix)
+        self.num_clients = self.w.shape[0]
+        assert dataset.num_clients == self.num_clients
+        opt = make_client_optimizer("sgd", lr, momentum=momentum)
+        self.local_update = make_local_update(bundle, opt, epochs, loss_fn)
+        self.round_fn = jax.jit(
+            make_gossip_round_fn(self.local_update, self.w)
+        )
+        self.evaluator = make_evaluator(bundle, loss_fn)
+        key = jax.random.PRNGKey(seed)
+        init = bundle.init(key)
+        # every worker starts from the same init (reference behavior)
+        self.stacked_vars = jax.tree_util.tree_map(
+            lambda l: jnp.stack([l] * self.num_clients), init
+        )
+        self.key = key
+        self.batch_size = batch_size
+        counts = dataset.client_sample_counts()
+        self.steps_per_epoch = max(1, int(np.ceil(int(counts.max()) / batch_size)))
+        self._test_pack = batch_eval_pack(dataset.test_x, dataset.test_y, 64)
+        self.round_idx = 0
+        self.history = []
+
+    def run_round(self) -> dict:
+        ids = np.arange(self.num_clients)
+        pack = pack_clients(
+            self.dataset, ids, self.batch_size,
+            steps_per_epoch=self.steps_per_epoch, seed=self.round_idx,
+        )
+        self.stacked_vars, metrics = self.round_fn(
+            self.stacked_vars,
+            jnp.asarray(pack.x), jnp.asarray(pack.y), jnp.asarray(pack.mask),
+            jax.random.fold_in(self.key, self.round_idx),
+            jnp.asarray(ids, jnp.int32),
+        )
+        out = {k: float(v) for k, v in metrics.items()}
+        out["round"] = self.round_idx
+        if out.get("count", 0) > 0:
+            out["train_acc"] = out["correct"] / out["count"]
+            out["train_loss"] = out["loss_sum"] / out["count"]
+        self.round_idx += 1
+        self.history.append(out)
+        return out
+
+    def evaluate_worker(self, worker: int) -> dict:
+        x, y, m = self._test_pack
+        variables = jax.tree_util.tree_map(lambda l: l[worker], self.stacked_vars)
+        res = self.evaluator(variables, jnp.asarray(x), jnp.asarray(y), jnp.asarray(m))
+        c = float(res["count"])
+        return {
+            "test_acc": float(res["correct"]) / max(c, 1.0),
+            "test_loss": float(res["loss_sum"]) / max(c, 1.0),
+        }
+
+    def consensus_distance(self) -> float:
+        """Mean squared distance of workers' params from their average —
+        the convergence diagnostic for gossip."""
+        mean = jax.tree_util.tree_map(
+            lambda l: l.mean(axis=0, keepdims=True), self.stacked_vars
+        )
+        d = jax.tree_util.tree_map(
+            lambda l, m: jnp.sum(jnp.square(l - m)), self.stacked_vars, mean
+        )
+        return float(sum(jax.tree_util.tree_leaves(d))) / self.num_clients
+
+    def run(self, rounds: int, log_fn=None) -> list:
+        for _ in range(rounds):
+            m = self.run_round()
+            if log_fn:
+                log_fn(m)
+        return self.history

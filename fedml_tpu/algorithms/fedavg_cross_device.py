@@ -1,0 +1,194 @@
+"""Cross-device FedAvg over the host message plane.
+
+This is the TPU rebuild of the reference's distributed FedAvg
+choreography (SURVEY.md §3.1): ``FedAvgServerManager`` /
+``FedAvgClientManager`` exchanging INIT_CONFIG / SEND_MODEL /
+SYNC_MODEL messages (``FedAvgServerManager.py:20-103``,
+``FedAvgClientManager.py:18-75``) — for the loosely-coupled setting
+where participants are NOT chips on one slice (the MQTT/mobile role).
+Compute still runs through the same jit local-update operator; only
+coordination travels as messages, over any ``CommBackend``
+(inproc for simulation, TCP hub for real cross-process runs).
+
+The tightly-coupled path (all clients on one TPU slice) should NOT use
+this module — use the compiled SPMD round (``fedml_tpu.parallel.spmd``),
+where "messages" are XLA collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.comm.backend import CommBackend, NodeManager
+from fedml_tpu.comm.message import (
+    MSG_ARG_KEY_CLIENT_INDEX,
+    MSG_ARG_KEY_LOCAL_METRICS,
+    MSG_ARG_KEY_MODEL_PARAMS,
+    MSG_ARG_KEY_NUM_SAMPLES,
+    MSG_ARG_KEY_ROUND_INDEX,
+    MSG_TYPE_C2S_SEND_MODEL,
+    MSG_TYPE_S2C_FINISH,
+    MSG_TYPE_S2C_INIT_CONFIG,
+    MSG_TYPE_S2C_SYNC_MODEL,
+    Message,
+    tree_from_wire,
+    tree_to_wire,
+)
+from fedml_tpu.core import tree as treelib
+from fedml_tpu.core.client import LocalUpdateFn
+from fedml_tpu.core.types import FedDataset, pack_clients
+
+SERVER = 0
+
+
+class FedAvgServerManager(NodeManager):
+    """Rank-0 coordinator: sample → broadcast → collect → aggregate."""
+
+    def __init__(
+        self,
+        backend: CommBackend,
+        init_variables,
+        *,
+        num_clients: int,
+        clients_per_round: int,
+        comm_rounds: int,
+        seed: int = 0,
+        steps_per_epoch: Optional[int] = None,
+    ):
+        # cohort-wide pack geometry: shipped to clients so a client's
+        # fixed-shape pack is IDENTICAL to its slice of the simulation's
+        # cohort pack (heterogeneous sizes would otherwise change batch
+        # counts and, with stateful optimizers, the trajectory)
+        self.steps_per_epoch = steps_per_epoch
+        self.variables = init_variables
+        self.num_clients = num_clients
+        self.clients_per_round = min(clients_per_round, num_clients)
+        self.comm_rounds = comm_rounds
+        self.seed = seed
+        self.round_idx = 0
+        self.pending: Dict[int, dict] = {}
+        self.round_log = []
+        super().__init__(backend)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_SEND_MODEL, self._on_model
+        )
+
+    # -- protocol --
+    def start(self):
+        for node in self._sampled_nodes():
+            self.send_message(self._model_msg(MSG_TYPE_S2C_INIT_CONFIG, node, node - 1))
+
+    def _sampled_nodes(self):
+        """Seeded uniform sampling every round (the fork's hardcoded
+        formula, FedAvgServerManager.py:66-75, is deliberately absent)."""
+        if self.clients_per_round >= self.num_clients:
+            ids = np.arange(self.num_clients)
+        else:
+            rng = np.random.RandomState(self.seed * 100003 + self.round_idx)
+            ids = np.sort(
+                rng.choice(self.num_clients, self.clients_per_round, replace=False)
+            )
+        return [int(i) + 1 for i in ids]  # node id = client id + 1
+
+    def _model_msg(self, msg_type: str, node: int, slot: int) -> Message:
+        m = Message(msg_type, SERVER, node)
+        m.add_params(MSG_ARG_KEY_MODEL_PARAMS, tree_to_wire(self.variables))
+        m.add_params(MSG_ARG_KEY_CLIENT_INDEX, node - 1)
+        m.add_params(MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+        m.add_params("slot", slot)  # global client id → rng stream id (matches SPMD slot_ids)
+        if self.steps_per_epoch is not None:
+            m.add_params("steps_per_epoch", self.steps_per_epoch)
+        return m
+
+    def _on_model(self, msg: Message):
+        self.pending[msg.sender] = {
+            "variables": tree_from_wire(
+                msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.variables
+            ),
+            "n": msg.get(MSG_ARG_KEY_NUM_SAMPLES),
+            "metrics": msg.get(MSG_ARG_KEY_LOCAL_METRICS) or {},
+        }
+        if len(self.pending) < self.clients_per_round:
+            return
+        # aggregate: sample-weighted average (FedAVGAggregator.py:58-87)
+        entries = list(self.pending.values())
+        total = sum(e["n"] for e in entries)
+        self.variables = treelib.tree_weighted_sum(
+            [e["variables"] for e in entries],
+            [e["n"] / total for e in entries],
+        )
+        self.round_log.append(
+            {"round": self.round_idx, "participants": sorted(self.pending)}
+        )
+        self.pending.clear()
+        self.round_idx += 1
+        if self.round_idx >= self.comm_rounds:
+            for node in range(1, self.num_clients + 1):
+                self.send_message(Message(MSG_TYPE_S2C_FINISH, SERVER, node))
+            self.finish()
+            return
+        for node in self._sampled_nodes():
+            self.send_message(self._model_msg(MSG_TYPE_S2C_SYNC_MODEL, node, node - 1))
+
+
+class FedAvgClientManager(NodeManager):
+    """One federated participant: train on INIT/SYNC, upload, repeat."""
+
+    def __init__(
+        self,
+        backend: CommBackend,
+        local_update: LocalUpdateFn,
+        dataset: FedDataset,
+        *,
+        batch_size: int,
+        template_variables,
+        seed: int = 0,
+    ):
+        self.local_update = jax.jit(local_update.fn)
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.template = template_variables
+        self.seed = seed
+        self.rounds_trained = 0
+        super().__init__(backend)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_TYPE_S2C_INIT_CONFIG, self._on_sync)
+        self.register_message_receive_handler(MSG_TYPE_S2C_SYNC_MODEL, self._on_sync)
+        self.register_message_receive_handler(MSG_TYPE_S2C_FINISH, self._on_finish)
+
+    def _on_sync(self, msg: Message):
+        variables = tree_from_wire(msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.template)
+        client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
+        round_idx = msg.get(MSG_ARG_KEY_ROUND_INDEX)
+        pack = pack_clients(
+            self.dataset, [client_idx], self.batch_size,
+            steps_per_epoch=msg.get("steps_per_epoch"),
+            seed=self.seed + round_idx,
+        )
+        # identical stream to the compiled round engine: key→round→train→slot
+        slot = msg.get("slot", client_idx)
+        k_round = jax.random.fold_in(jax.random.PRNGKey(self.seed), round_idx)
+        rng = jax.random.fold_in(jax.random.fold_in(k_round, 0), slot)
+        new_vars, metrics = self.local_update(
+            variables,
+            jnp.asarray(pack.x[0]), jnp.asarray(pack.y[0]),
+            jnp.asarray(pack.mask[0]), rng,
+        )
+        self.rounds_trained += 1
+        reply = Message(MSG_TYPE_C2S_SEND_MODEL, self.backend.node_id, SERVER)
+        reply.add_params(MSG_ARG_KEY_MODEL_PARAMS, tree_to_wire(new_vars))
+        reply.add_params(MSG_ARG_KEY_NUM_SAMPLES, float(pack.num_samples[0]))
+        reply.add_params(
+            MSG_ARG_KEY_LOCAL_METRICS, {k: float(v) for k, v in metrics.items()}
+        )
+        self.send_message(reply)
+
+    def _on_finish(self, msg: Message):
+        self.finish()

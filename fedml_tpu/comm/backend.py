@@ -1,0 +1,110 @@
+"""Communication backend protocol.
+
+Reference ``fedml_core/distributed/communication/base_com_manager.py:7-27``
+(+ ``observer.py:4-7``): ``send_message`` / ``add_observer`` /
+``handle_receive_message`` / ``stop_receive_message``, with backend
+selection by string switch (``client_manager.py:19-28``).
+
+Rebuild (SURVEY.md §2.7): a ``CommBackend`` protocol with
+
+- ``inproc``  — deterministic in-process bus (simulation; replaces the
+  reference's localhost-mpirun testing mode, no threads, no polling);
+- ``tcp``     — JSON-lines-over-TCP hub for the loosely-coupled
+  cross-device role the reference gives MQTT (works cross-process /
+  cross-host on DCN, zero external deps);
+- ``ici``     — NOT a message backend at all: inside a slice the data
+  plane is XLA collectives compiled into the round program
+  (``fedml_tpu.parallel.spmd``); only control metadata would ever
+  travel here.
+
+The reference's thread-kill-via-ctypes and 0.3 s polling loops
+(SURVEY.md §5.2) are designed away: inproc is synchronous, tcp uses
+blocking socket reads on a dedicated reader thread with a sentinel
+shutdown message, and device-side sync is XLA's.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Callable, Dict, List
+
+from fedml_tpu.comm.message import Message
+
+Handler = Callable[[Message], None]
+
+
+class Observer(abc.ABC):
+    @abc.abstractmethod
+    def receive_message(self, msg_type: str, msg: Message) -> None:
+        ...
+
+
+class CommBackend(abc.ABC):
+    """Transport: deliver Message envelopes between integer node ids."""
+
+    def __init__(self, node_id: int):
+        self.node_id = node_id
+        self._observers: List[Observer] = []
+
+    @abc.abstractmethod
+    def send_message(self, msg: Message) -> None:
+        ...
+
+    @abc.abstractmethod
+    def run(self) -> None:
+        """Deliver incoming messages to observers until stopped."""
+
+    @abc.abstractmethod
+    def stop(self) -> None:
+        ...
+
+    def add_observer(self, obs: Observer) -> None:
+        self._observers.append(obs)
+
+    def remove_observer(self, obs: Observer) -> None:
+        self._observers.remove(obs)
+
+    def _notify(self, msg: Message) -> None:
+        for obs in list(self._observers):
+            obs.receive_message(msg.type, msg)
+
+
+class NodeManager(Observer):
+    """Base for server/client managers: handler registry + event loop.
+
+    Reference ``fedml_core/distributed/{client,server}``
+    (``client_manager.py:12-65``): ``register_message_receive_handler``,
+    ``send_message``, ``run``, ``finish`` — minus the
+    ``MPI.COMM_WORLD.Abort()`` shutdown (a graceful FINISH message +
+    backend stop instead).
+    """
+
+    def __init__(self, backend: CommBackend):
+        self.backend = backend
+        self.backend.add_observer(self)
+        self._handlers: Dict[str, Handler] = {}
+        self.register_message_receive_handlers()
+
+    # subclasses override
+    def register_message_receive_handlers(self) -> None:
+        ...
+
+    def register_message_receive_handler(self, msg_type: str, fn: Handler) -> None:
+        self._handlers[msg_type] = fn
+
+    def receive_message(self, msg_type: str, msg: Message) -> None:
+        handler = self._handlers.get(msg_type)
+        if handler is None:
+            raise KeyError(
+                f"node {self.backend.node_id}: no handler for {msg_type!r}"
+            )
+        handler(msg)
+
+    def send_message(self, msg: Message) -> None:
+        self.backend.send_message(msg)
+
+    def run(self) -> None:
+        self.backend.run()
+
+    def finish(self) -> None:
+        self.backend.stop()

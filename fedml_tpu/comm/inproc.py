@@ -1,0 +1,74 @@
+"""In-process communication bus — the simulation backend.
+
+Replaces the reference's "multi-node without a cluster" testing mode
+(localhost mpirun, SURVEY.md §4.4) with a deterministic single-threaded
+bus: messages enqueue globally in send order and are drained round-robin
+by each node's ``run()`` (or by ``InprocBus.drain()`` for a fully
+synchronous co-routine-style simulation).  No threads, no sleeps, no
+polling — the reference's 0.3 s busy-wait loops (``com_manager.py:78``)
+have no equivalent here.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict
+
+from fedml_tpu.comm.backend import CommBackend
+from fedml_tpu.comm.message import Message
+
+
+class InprocBus:
+    """Shared router for any number of InprocBackend endpoints."""
+
+    def __init__(self):
+        self.queues: Dict[int, deque] = {}
+        self.stopped: Dict[int, bool] = {}
+        self._backends: Dict[int, "InprocBackend"] = {}
+
+    def register(self, node_id: int) -> "InprocBackend":
+        self.queues[node_id] = deque()
+        self.stopped[node_id] = False
+        return InprocBackend(node_id, self)
+
+    def route(self, msg: Message) -> None:
+        if msg.receiver not in self.queues:
+            raise KeyError(f"unknown receiver {msg.receiver}")
+        self.queues[msg.receiver].append(msg)
+
+    def drain(self, max_steps: int = 100000) -> int:
+        """Deliver queued messages (in global arrival order across nodes)
+        until quiescent; handlers may enqueue more.  Returns deliveries."""
+        delivered = 0
+        for _ in range(max_steps):
+            progressed = False
+            for node_id, q in self.queues.items():
+                if q and not self.stopped[node_id]:
+                    msg = q.popleft()
+                    self._backends[node_id]._notify(msg)
+                    delivered += 1
+                    progressed = True
+                    break  # strict global ordering
+            if not progressed:
+                return delivered
+        raise RuntimeError("inproc bus did not quiesce (message storm?)")
+
+    def attach(self, backend: "InprocBackend"):
+        self._backends[backend.node_id] = backend
+
+
+class InprocBackend(CommBackend):
+    def __init__(self, node_id: int, bus: InprocBus):
+        super().__init__(node_id)
+        self.bus = bus
+        bus.attach(self)
+
+    def send_message(self, msg: Message) -> None:
+        self.bus.route(msg)
+
+    def run(self) -> None:
+        # synchronous: delivery is driven by bus.drain()
+        self.bus.drain()
+
+    def stop(self) -> None:
+        self.bus.stopped[self.node_id] = True

@@ -1,0 +1,171 @@
+"""Message envelope + the FL control-plane vocabulary.
+
+Reference ``fedml_core/distributed/communication/message.py:5-74``: a
+typed key-value envelope with reserved keys for type/sender/receiver and
+a JSON codec; model weights travel inside the dict
+(``MSG_ARG_KEY_MODEL_PARAMS``).  The semantic message types come from
+``fedml_api/distributed/fedavg/message_define.py:6-31``.
+
+On TPU the data plane (weights) rides XLA collectives; this Message
+layer is the HOST control plane for loosely-coupled/cross-device modes
+(the reference's MQTT role) and for the inproc simulation backend.
+Arrays are encoded as nested lists (the reference's
+``transform_tensor_to_list`` codec, ``fedavg/utils.py:5-14``) or as
+base64 float32 buffers — the compact default.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+from typing import Any, Dict
+
+import numpy as np
+
+# --- reserved keys ---------------------------------------------------------
+MSG_ARG_KEY_TYPE = "msg_type"
+MSG_ARG_KEY_SENDER = "sender"
+MSG_ARG_KEY_RECEIVER = "receiver"
+MSG_ARG_KEY_MODEL_PARAMS = "model_params"
+MSG_ARG_KEY_NUM_SAMPLES = "num_samples"
+MSG_ARG_KEY_CLIENT_INDEX = "client_idx"
+MSG_ARG_KEY_ROUND_INDEX = "round_idx"
+MSG_ARG_KEY_LOCAL_METRICS = "local_metrics"
+
+# --- message types (semantic vocabulary) -----------------------------------
+MSG_TYPE_S2C_INIT_CONFIG = "S2C_INIT_CONFIG"
+MSG_TYPE_S2C_SYNC_MODEL = "S2C_SYNC_MODEL"
+MSG_TYPE_C2S_SEND_MODEL = "C2S_SEND_MODEL"
+MSG_TYPE_C2S_SEND_STATS = "C2S_SEND_STATS"
+MSG_TYPE_S2C_FINISH = "S2C_FINISH"
+# split-learning extras (reference split_nn/message_define.py:6-16)
+MSG_TYPE_C2S_SEND_ACTS = "C2S_SEND_ACTS"
+MSG_TYPE_S2C_SEND_GRADS = "S2C_SEND_GRADS"
+MSG_TYPE_C2C_SEMAPHORE = "C2C_SEMAPHORE"
+
+
+class Message:
+    def __init__(self, msg_type: str = "", sender: int = 0, receiver: int = 0):
+        self.params: Dict[str, Any] = {
+            MSG_ARG_KEY_TYPE: msg_type,
+            MSG_ARG_KEY_SENDER: sender,
+            MSG_ARG_KEY_RECEIVER: receiver,
+        }
+
+    # -- reference API surface --
+    def add_params(self, key: str, value: Any) -> "Message":
+        self.params[key] = value
+        return self
+
+    add = add_params
+
+    def get(self, key: str, default=None) -> Any:
+        return self.params.get(key, default)
+
+    @property
+    def type(self) -> str:
+        return self.params[MSG_ARG_KEY_TYPE]
+
+    @property
+    def sender(self) -> int:
+        return self.params[MSG_ARG_KEY_SENDER]
+
+    @property
+    def receiver(self) -> int:
+        return self.params[MSG_ARG_KEY_RECEIVER]
+
+    def to_json(self) -> str:
+        return json.dumps(self.params, default=_encode_value)
+
+    @classmethod
+    def from_json(cls, payload: str) -> "Message":
+        obj = json.loads(payload)
+        m = cls()
+        m.params = {k: _decode_value(v) for k, v in obj.items()}
+        return m
+
+    def __repr__(self):
+        return f"Message({self.type}, {self.sender}->{self.receiver}, keys={list(self.params)})"
+
+
+# --- pytree <-> wire codecs -------------------------------------------------
+
+def tree_to_wire(tree: Any) -> Any:
+    """Pytree of arrays → JSON-able nested structure with b64 buffers."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return {
+        "__pytree__": str(treedef),
+        "leaves": [_encode_array(np.asarray(l)) for l in leaves],
+        "treedef_repr": None,
+    }
+
+
+def tree_from_wire(obj: Any, like: Any) -> Any:
+    """Decode against a structural template ``like`` (same treedef)."""
+    import jax
+
+    leaves_like, treedef = jax.tree_util.tree_flatten(like)
+    leaves = [_decode_array(e) for e in obj["leaves"]]
+    assert len(leaves) == len(leaves_like), "wire/treedef leaf count mismatch"
+    leaves = [
+        np.asarray(l, dtype=np.asarray(ref).dtype).reshape(np.asarray(ref).shape)
+        for l, ref in zip(leaves, leaves_like)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _encode_array(a: np.ndarray) -> dict:
+    return {
+        "__ndarray__": base64.b64encode(np.ascontiguousarray(a).tobytes()).decode(),
+        "dtype": str(a.dtype),
+        "shape": list(a.shape),
+    }
+
+
+def _decode_array(obj: dict) -> np.ndarray:
+    buf = base64.b64decode(obj["__ndarray__"])
+    return np.frombuffer(buf, dtype=np.dtype(obj["dtype"])).reshape(obj["shape"])
+
+
+def _encode_value(v):
+    if isinstance(v, np.ndarray):
+        return _encode_array(v)
+    if isinstance(v, (np.integer, np.floating)):
+        return v.item()
+    if hasattr(v, "dtype") and hasattr(v, "shape"):  # jax array
+        return _encode_array(np.asarray(v))
+    raise TypeError(f"not JSON-serializable: {type(v)}")
+
+
+def _decode_value(v):
+    """Recursive decode: arrays survive the roundtrip at ANY nesting depth
+    (encoding recurses via json.dumps default=, so decoding must too)."""
+    if isinstance(v, dict):
+        if "__ndarray__" in v:
+            return _decode_array(v)
+        if "__pytree__" in v:
+            return v  # decoded lazily via tree_from_wire (needs template)
+        return {k: _decode_value(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_decode_value(x) for x in v]
+    return v
+
+
+def tensor_to_list(tree: Any) -> Any:
+    """The reference's mobile/MQTT codec (``fedavg/utils.py:11-14``):
+    arrays become nested python lists."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda a: np.asarray(a).tolist(), tree)
+
+
+def list_to_tensor(tree: Any) -> Any:
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda l: np.asarray(l, dtype=np.float32),
+        tree,
+        is_leaf=lambda x: isinstance(x, list),
+    )

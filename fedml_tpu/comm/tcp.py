@@ -1,0 +1,156 @@
+"""TCP hub backend — the cross-device / DCN control plane.
+
+Fills the role the reference gives MQTT
+(``communication/mqtt/mqtt_comm_manager.py:14-126``: broker pub/sub with
+JSON payloads for loosely-coupled mobile clients) with zero external
+dependencies: a hub process accepts connections, each node registers its
+integer id (hub ACKs the registration — sends before the ACK cannot
+race past an unregistered receiver), and JSON-lines frames are routed
+by receiver id.  Weights ride the Message codec (base64 f32 buffers, or
+the reference's list-codec via ``tensor_to_list`` for mobile parity).
+
+Design notes vs the reference's MPI threads (SURVEY.md §5.2): one
+blocking reader thread per connection, shutdown via sentinel frame and
+socket close — no ctypes thread kills, no polling sleeps.  A dead or
+misbehaving peer only loses its own frames: routing errors are caught,
+the stale connection is dropped, and other nodes keep flowing.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Dict, Optional
+
+from fedml_tpu.comm.backend import CommBackend
+from fedml_tpu.comm.message import Message
+
+_SENTINEL = {"__hub__": "stop"}
+_ACK = {"__hub__": "ack"}
+
+
+class TcpHub:
+    """Central router: node_id → connection. Start once per federation."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._srv = socket.create_server((host, port))
+        self.host, self.port = self._srv.getsockname()
+        self._conns: Dict[int, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._running = True
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+
+    def _accept_loop(self):
+        while self._running:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket):
+        node_id = None
+        try:
+            f = conn.makefile("rb")
+            hello = f.readline()
+            if not hello:
+                return
+            node_id = json.loads(hello)["node_id"]
+            with self._lock:
+                self._conns[node_id] = conn
+            conn.sendall((json.dumps(_ACK) + "\n").encode())
+            while True:
+                line = f.readline()
+                if not line:
+                    break
+                try:
+                    frame = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # drop malformed frame, keep the connection
+                if frame.get("__hub__") == "stop":
+                    break
+                receiver = frame.get("receiver")
+                if receiver is not None:
+                    self._forward(receiver, line)
+        except OSError:
+            pass  # peer vanished: fall through to cleanup
+        finally:
+            if node_id is not None:
+                with self._lock:
+                    self._conns.pop(node_id, None)
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def _forward(self, receiver: int, raw_line: bytes):
+        with self._lock:
+            conn = self._conns.get(receiver)
+        if conn is None:
+            return
+        try:
+            conn.sendall(raw_line if raw_line.endswith(b"\n") else raw_line + b"\n")
+        except OSError:
+            # dead receiver: unregister so later sends don't retry it;
+            # its own reader thread finishes cleanup
+            with self._lock:
+                if self._conns.get(receiver) is conn:
+                    self._conns.pop(receiver, None)
+
+    def stop(self):
+        self._running = False
+        with self._lock:
+            conns = list(self._conns.values())
+        for c in conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._srv.close()
+
+
+class TcpBackend(CommBackend):
+    def __init__(self, node_id: int, host: str, port: int, timeout: float = 30.0):
+        super().__init__(node_id)
+        self._sock = socket.create_connection((host, port), timeout=timeout)
+        self._sock.sendall((json.dumps({"node_id": node_id}) + "\n").encode())
+        self._file = self._sock.makefile("rb")
+        # wait for the hub's registration ACK: afterwards, any frame sent
+        # TO this node can be delivered — no startup race
+        ack = self._file.readline()
+        if not ack or json.loads(ack).get("__hub__") != "ack":
+            raise ConnectionError(f"node {node_id}: no hub ACK")
+        self._sock.settimeout(None)
+        self._stopped = threading.Event()
+
+    def send_message(self, msg: Message) -> None:
+        # to_json() is already one valid JSON line (newlines escape inside
+        # JSON strings) — no re-parse needed
+        self._sock.sendall((msg.to_json() + "\n").encode())
+
+    def run(self) -> None:
+        while not self._stopped.is_set():
+            line = self._file.readline()
+            if not line:
+                return
+            frame = json.loads(line)
+            if frame.get("__hub__") == "stop":
+                return
+            self._notify(Message.from_json(line.decode()))
+
+    def run_in_thread(self) -> threading.Thread:
+        t = threading.Thread(target=self.run, daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self._stopped.set()
+        try:
+            self._sock.sendall((json.dumps(_SENTINEL) + "\n").encode())
+            self._sock.close()
+        except OSError:
+            pass

@@ -1,0 +1,130 @@
+"""Topology managers for decentralized FL.
+
+Reference ``fedml_core/distributed/topology/``:
+- ``SymmetricTopologyManager.generate_topology``
+  (``symmetric_topology_manager.py:21-52``): ring + Watts–Strogatz-style
+  random symmetric links (``neighbor_num`` per node), row-normalized to
+  a doubly-stochastic-ish mixing matrix.
+- ``AsymmetricTopologyManager`` (``asymmetric_topology_manager.py:23-74``):
+  same undirected base, then randomly deletes directed links and
+  row-normalizes — rows no longer match columns.
+- ``BaseTopologyManager`` API (``base_topology_manager.py:4-23``):
+  in/out neighbor index and weight queries per node.
+
+The matrices are built host-side with numpy/networkx (one-off setup, not
+a TPU op); the gossip round consumes them as a dense [N,N] mixing matrix
+(``einsum`` on-device) or as ppermute schedules for sparse rings.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import networkx as nx
+import numpy as np
+
+
+class BaseTopologyManager:
+    """In/out neighbor queries over a row-stochastic mixing matrix."""
+
+    topology: np.ndarray  # [N, N]; row i = weights node i uses to mix IN
+
+    def get_in_neighbor_idx_list(self, node_index: int) -> List[int]:
+        return [
+            j for j in range(self.n) if self.topology[node_index, j] > 0 and j != node_index
+        ]
+
+    def get_out_neighbor_idx_list(self, node_index: int) -> List[int]:
+        return [
+            i for i in range(self.n) if self.topology[i, node_index] > 0 and i != node_index
+        ]
+
+    def get_in_neighbor_weights(self, node_index: int) -> List[float]:
+        return self.topology[node_index].tolist()
+
+    def get_out_neighbor_weights(self, node_index: int) -> List[float]:
+        return self.topology[:, node_index].tolist()
+
+    @property
+    def n(self) -> int:
+        return self.topology.shape[0]
+
+
+def _ring_plus_random(n: int, neighbor_num: int, seed: int) -> np.ndarray:
+    """Symmetric 0/1 adjacency: ring + random extra symmetric links,
+    self-loops included (a node always keeps its own model)."""
+    if n == 1:
+        return np.ones((1, 1))
+    # connected Watts-Strogatz ring lattice with k neighbors, then add
+    # random symmetric links like the reference's second phase
+    k = max(2, min(neighbor_num, n - 1))
+    g = nx.watts_strogatz_graph(n, k if k % 2 == 0 else k + 1, 0.0, seed=seed)
+    adj = nx.to_numpy_array(g)
+    rng = np.random.RandomState(seed)
+    extra = max(0, neighbor_num - 2)
+    for i in range(n):
+        candidates = [j for j in range(n) if j != i and adj[i, j] == 0]
+        rng.shuffle(candidates)
+        for j in candidates[:extra]:
+            adj[i, j] = adj[j, i] = 1
+    np.fill_diagonal(adj, 1)
+    return adj
+
+
+class SymmetricTopologyManager(BaseTopologyManager):
+    """Undirected topology, row-normalized to uniform neighbor weights."""
+
+    def __init__(self, n: int, neighbor_num: int = 2, seed: int = 0):
+        self._n = n
+        self.neighbor_num = neighbor_num
+        self.seed = seed
+        self.topology = np.zeros((n, n))
+
+    def generate_topology(self):
+        adj = _ring_plus_random(self._n, self.neighbor_num, self.seed)
+        self.topology = adj / adj.sum(axis=1, keepdims=True)
+        return self.topology
+
+
+class AsymmetricTopologyManager(BaseTopologyManager):
+    """Symmetric base with randomly deleted directed links (reference's
+    ``undirected_neighbor_num`` then per-row pruning), row-normalized."""
+
+    def __init__(
+        self,
+        n: int,
+        undirected_neighbor_num: int = 3,
+        out_directed_neighbor: int = 2,
+        seed: int = 0,
+    ):
+        self._n = n
+        self.undirected_neighbor_num = undirected_neighbor_num
+        self.out_directed_neighbor = out_directed_neighbor
+        self.seed = seed
+        self.topology = np.zeros((n, n))
+
+    def generate_topology(self):
+        adj = _ring_plus_random(self._n, self.undirected_neighbor_num, self.seed)
+        rng = np.random.RandomState(self.seed + 1)
+        n = self._n
+        for i in range(n):
+            # ring links (i±1) are never pruned: the directed graph must
+            # stay strongly connected or PushSum weights collapse onto a
+            # sink node (u_i → 0 ⇒ z_i/u_i diverges)
+            ring = {(i - 1) % n, (i + 1) % n}
+            extra = [j for j in range(n) if j != i and adj[i, j] > 0 and j not in ring]
+            rng.shuffle(extra)
+            for j in extra[self.out_directed_neighbor:]:
+                adj[i, j] = 0
+        np.fill_diagonal(adj, 1)
+        self.topology = adj / adj.sum(axis=1, keepdims=True)
+        return self.topology
+
+
+def ring_topology(n: int) -> np.ndarray:
+    """Plain ring mixing matrix (1/3 self, 1/3 left, 1/3 right) — the
+    sparse case that maps to ``lax.ppermute`` on an ICI ring."""
+    w = np.zeros((n, n))
+    for i in range(n):
+        w[i, i] = w[i, (i - 1) % n] = w[i, (i + 1) % n] = 1.0
+    return w / w.sum(axis=1, keepdims=True)

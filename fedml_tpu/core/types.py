@@ -148,10 +148,12 @@ def pack_clients(
         steps_per_epoch = max(1, int(np.ceil(max(max(counts), 1) / batch_size)))
     total = steps_per_epoch * batch_size
 
-    rng = np.random.RandomState(seed)
     xs, ys, ms, ns = [], [], [], []
     feat_shape = dataset.train_x.shape[1:]
     for c in client_ids:
+        # per-client seeding: a client's pack is identical whether packed
+        # alone (cross-device manager) or in a cohort (simulation/SPMD)
+        rng = np.random.RandomState((seed * 1000003 + int(c) * 7919 + 1) % (2**31))
         idx = np.asarray(dataset.train_client_idx[c])
         n = len(idx)
         if n == 0:

@@ -142,6 +142,7 @@ class DecentralizedSimulation:
             lambda l: jnp.stack([l] * self.num_clients), init
         )
         self.key = key
+        self.seed = seed
         self.batch_size = batch_size
         counts = dataset.client_sample_counts()
         self.steps_per_epoch = max(1, int(np.ceil(int(counts.max()) / batch_size)))
@@ -153,7 +154,7 @@ class DecentralizedSimulation:
         ids = np.arange(self.num_clients)
         pack = pack_clients(
             self.dataset, ids, self.batch_size,
-            steps_per_epoch=self.steps_per_epoch, seed=self.round_idx,
+            steps_per_epoch=self.steps_per_epoch, seed=self.seed + self.round_idx,
         )
         self.stacked_vars, metrics = self.round_fn(
             self.stacked_vars,

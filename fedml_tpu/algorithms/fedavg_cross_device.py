@@ -81,8 +81,11 @@ class FedAvgServerManager(NodeManager):
 
     # -- protocol --
     def start(self):
+        wire = tree_to_wire(self.variables)  # encode once, fan out N times
         for node in self._sampled_nodes():
-            self.send_message(self._model_msg(MSG_TYPE_S2C_INIT_CONFIG, node, node - 1))
+            self.send_message(
+                self._model_msg(MSG_TYPE_S2C_INIT_CONFIG, node, node - 1, wire)
+            )
 
     def _sampled_nodes(self):
         """Seeded uniform sampling every round (the fork's hardcoded
@@ -96,9 +99,9 @@ class FedAvgServerManager(NodeManager):
             )
         return [int(i) + 1 for i in ids]  # node id = client id + 1
 
-    def _model_msg(self, msg_type: str, node: int, slot: int) -> Message:
+    def _model_msg(self, msg_type: str, node: int, slot: int, wire) -> Message:
         m = Message(msg_type, SERVER, node)
-        m.add_params(MSG_ARG_KEY_MODEL_PARAMS, tree_to_wire(self.variables))
+        m.add_params(MSG_ARG_KEY_MODEL_PARAMS, wire)
         m.add_params(MSG_ARG_KEY_CLIENT_INDEX, node - 1)
         m.add_params(MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
         m.add_params("slot", slot)  # global client id → rng stream id (matches SPMD slot_ids)
@@ -133,8 +136,11 @@ class FedAvgServerManager(NodeManager):
                 self.send_message(Message(MSG_TYPE_S2C_FINISH, SERVER, node))
             self.finish()
             return
+        wire = tree_to_wire(self.variables)
         for node in self._sampled_nodes():
-            self.send_message(self._model_msg(MSG_TYPE_S2C_SYNC_MODEL, node, node - 1))
+            self.send_message(
+                self._model_msg(MSG_TYPE_S2C_SYNC_MODEL, node, node - 1, wire)
+            )
 
 
 class FedAvgClientManager(NodeManager):

@@ -1,0 +1,199 @@
+"""Classical vertical FL (feature-split), TPU-native.
+
+Reference mechanism (SURVEY.md §2 row 17, §3):
+``classical_vertical_fl/guest_trainer.py:74-110`` — hosts each forward
+their private feature slice and send logit components; the guest adds
+its own logits, computes ``BCEWithLogitsLoss`` on the sum, and returns
+the **common gradient** dL/dU to every host
+(``party_models.py:56-75``); each party then backprops its own branch
+with that gradient (SGD momentum 0.9, weight-decay 0.01,
+``vfl_models_standalone.py:13,46``).
+
+TPU-native design: the whole multi-party step is ONE jitted program.
+Each party's forward is captured as a ``jax.vjp``; the guest loss is
+differentiated once w.r.t. the summed logits ``U``; that single [B, 1]
+cotangent — the exact tensor the reference ships over the network —
+fans back through every party's vjp.  This is mathematically identical
+to joint autodiff but keeps the protocol's structure: the only value
+crossing party boundaries is (logit components up, common gradient
+down), so the same step functions drive an inproc simulation or a real
+multi-host deployment over the comm backend with parties on separate
+hosts.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.models.base import ModelBundle
+
+PyTree = Any
+
+
+class PartyState(NamedTuple):
+    params: PyTree
+    opt_state: Any
+
+
+def bce_with_logits(logits: jax.Array, y: jax.Array) -> jax.Array:
+    """Mean binary cross-entropy on logits (torch BCEWithLogitsLoss)."""
+    y = y.reshape(logits.shape).astype(logits.dtype)
+    return jnp.mean(
+        jnp.clip(logits, 0) - logits * y + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
+def _party_optimizer(lr: float, momentum: float = 0.9, weight_decay: float = 0.01):
+    """Reference party optimizer: SGD(momentum=0.9, wd=0.01)
+    (``vfl_models_standalone.py:13``)."""
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.sgd(lr, momentum=momentum),
+    )
+
+
+@dataclasses.dataclass
+class VerticalFederation:
+    """Guest (party 0, holds labels) + hosts, each with a private feature
+    slice.  Mirrors the standalone driver
+    ``VerticalMultiplePartyLogisticRegressionFederatedLearning.fit``
+    (``vfl.py:21-49``) and the distributed guest/host trainer pair
+    (``guest_trainer.py``, ``host_trainer.py``).
+
+    ``bundles[i]`` consumes feature slice i; party 0 is the guest.
+    """
+
+    bundles: Sequence[ModelBundle]
+    lr: float = 0.05
+    momentum: float = 0.9
+    weight_decay: float = 0.01
+
+    def __post_init__(self):
+        self._opt = _party_optimizer(self.lr, self.momentum, self.weight_decay)
+        self._step = jax.jit(self._make_step())
+        self._predict = jax.jit(self._make_predict())
+
+    # -- state ---------------------------------------------------------
+    def init(self, rng: jax.Array) -> Tuple[PartyState, ...]:
+        states = []
+        for i, b in enumerate(self.bundles):
+            params = b.init(jax.random.fold_in(rng, i))["params"]
+            states.append(PartyState(params, self._opt.init(params)))
+        return tuple(states)
+
+    # -- compiled step -------------------------------------------------
+    def _apply(self, bundle: ModelBundle, params: PyTree, x: jax.Array):
+        return bundle.module.apply({"params": params}, x, train=True)
+
+    def _make_step(self):
+        bundles = tuple(self.bundles)
+        opt = self._opt
+
+        def step(states: Tuple[PartyState, ...], xs: Tuple[jax.Array, ...], y):
+            # Forward every party, capturing its branch's vjp.
+            logits, vjps = [], []
+            for b, st, x in zip(bundles, states, xs):
+                out, vjp = jax.vjp(lambda p, _b=b, _x=x: self._apply(_b, p, _x), st.params)
+                logits.append(out)
+                vjps.append(vjp)
+            U = sum(logits)  # guest sums components (guest_trainer.py:87-89)
+            loss, dU = jax.value_and_grad(bce_with_logits)(U, y)
+            # dU is the common gradient — the single tensor the guest
+            # sends to every host (guest_trainer.py:96-104).
+            new_states = []
+            for st, vjp in zip(states, vjps):
+                (g,) = vjp(dU)
+                updates, opt_state = opt.update(g, st.opt_state, st.params)
+                new_states.append(
+                    PartyState(optax.apply_updates(st.params, updates), opt_state)
+                )
+            return tuple(new_states), loss
+
+        return step
+
+    def _make_predict(self):
+        bundles = tuple(self.bundles)
+
+        def predict(states, xs):
+            U = sum(
+                b.module.apply({"params": st.params}, x, train=False)
+                for b, st, x in zip(bundles, states, xs)
+            )
+            # reference predict: sigmoid(sum U) (party_models.py:42-47)
+            return jax.nn.sigmoid(jnp.sum(U, axis=1))
+
+        return predict
+
+    # -- driver API (mirrors vfl_fixture.py) ---------------------------
+    def fit(self, states, xs, y):
+        """One global step on one batch; returns (new_states, loss)."""
+        return self._step(states, tuple(xs), y)
+
+    def predict(self, states, xs) -> jax.Array:
+        return self._predict(states, tuple(xs))
+
+    def evaluate(self, states, xs, y) -> dict:
+        prob = np.asarray(self.predict(states, xs))
+        y = np.asarray(y).reshape(-1)
+        pred = (prob > 0.5).astype(np.int32)
+        acc = float((pred == y).mean())
+        return {"accuracy": acc, "auc": float(binary_auc(y, prob))}
+
+
+def binary_auc(y_true: np.ndarray, score: np.ndarray) -> float:
+    """Rank-based ROC-AUC (ties averaged) — replaces the reference's
+    sklearn ``roc_auc_score`` (``guest_trainer.py:5-6``) without the
+    dependency."""
+    y_true = np.asarray(y_true).reshape(-1)
+    score = np.asarray(score).reshape(-1)
+    pos, neg = (y_true == 1).sum(), (y_true == 0).sum()
+    if pos == 0 or neg == 0:
+        return float("nan")
+    order = np.argsort(score, kind="mergesort")
+    ranks = np.empty_like(order, dtype=np.float64)
+    ranks[order] = np.arange(1, len(score) + 1)
+    # average ranks over ties
+    for s in np.unique(score):
+        m = score == s
+        ranks[m] = ranks[m].mean()
+    return float((ranks[y_true == 1].sum() - pos * (pos + 1) / 2) / (pos * neg))
+
+
+def run_vfl(
+    federation: VerticalFederation,
+    xs_train: Sequence[np.ndarray],
+    y_train: np.ndarray,
+    xs_test: Sequence[np.ndarray],
+    y_test: np.ndarray,
+    *,
+    rng: Optional[jax.Array] = None,
+    epochs: int = 1,
+    batch_size: int = 256,
+    eval_every: int = 1,
+) -> Tuple[Tuple[PartyState, ...], List[dict]]:
+    """Batch-loop driver, the ``FederatedLearningFixture`` analogue
+    (``vfl_fixture.py:27+``)."""
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    states = federation.init(rng)
+    n = len(y_train)
+    history = []
+    step = 0
+    for epoch in range(epochs):
+        for lo in range(0, n, batch_size):
+            sl = slice(lo, min(lo + batch_size, n))
+            xs = [jnp.asarray(x[sl]) for x in xs_train]
+            states, loss = federation.fit(states, xs, jnp.asarray(y_train[sl]))
+            step += 1
+        if (epoch + 1) % eval_every == 0:
+            m = federation.evaluate(
+                states, [jnp.asarray(x) for x in xs_test], y_test
+            )
+            m.update(epoch=epoch, step=step)
+            history.append(m)
+    return states, history

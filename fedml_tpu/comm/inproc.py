@@ -22,35 +22,36 @@ class InprocBus:
     """Shared router for any number of InprocBackend endpoints."""
 
     def __init__(self):
-        self.queues: Dict[int, deque] = {}
         self.stopped: Dict[int, bool] = {}
+        self._registered: Dict[int, bool] = {}
         self._backends: Dict[int, "InprocBackend"] = {}
+        # one global FIFO of (receiver, msg): delivery follows true
+        # cross-node send order, exactly as the drain docstring promises
+        self._fifo: deque = deque()
 
     def register(self, node_id: int) -> "InprocBackend":
-        self.queues[node_id] = deque()
+        self._registered[node_id] = True
         self.stopped[node_id] = False
         return InprocBackend(node_id, self)
 
     def route(self, msg: Message) -> None:
-        if msg.receiver not in self.queues:
+        if msg.receiver not in self._registered:
             raise KeyError(f"unknown receiver {msg.receiver}")
-        self.queues[msg.receiver].append(msg)
+        self._fifo.append(msg)
 
     def drain(self, max_steps: int = 100000) -> int:
-        """Deliver queued messages (in global arrival order across nodes)
-        until quiescent; handlers may enqueue more.  Returns deliveries."""
+        """Deliver queued messages in global send order until quiescent;
+        handlers may enqueue more.  Messages to stopped nodes are
+        discarded (the node has finished).  Returns deliveries."""
         delivered = 0
         for _ in range(max_steps):
-            progressed = False
-            for node_id, q in self.queues.items():
-                if q and not self.stopped[node_id]:
-                    msg = q.popleft()
-                    self._backends[node_id]._notify(msg)
-                    delivered += 1
-                    progressed = True
-                    break  # strict global ordering
-            if not progressed:
+            if not self._fifo:
                 return delivered
+            msg = self._fifo.popleft()
+            if self.stopped.get(msg.receiver, True):
+                continue
+            self._backends[msg.receiver]._notify(msg)
+            delivered += 1
         raise RuntimeError("inproc bus did not quiesce (message storm?)")
 
     def attach(self, backend: "InprocBackend"):

@@ -94,11 +94,10 @@ def tree_to_wire(tree: Any) -> Any:
     """Pytree of arrays → JSON-able nested structure with b64 buffers."""
     import jax
 
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    leaves, _ = jax.tree_util.tree_flatten(tree)
     return {
-        "__pytree__": str(treedef),
+        "__wiretree__": 1,
         "leaves": [_encode_array(np.asarray(l)) for l in leaves],
-        "treedef_repr": None,
     }
 
 
@@ -145,8 +144,8 @@ def _decode_value(v):
     if isinstance(v, dict):
         if "__ndarray__" in v:
             return _decode_array(v)
-        if "__pytree__" in v:
-            return v  # decoded lazily via tree_from_wire (needs template)
+        if "__wiretree__" in v:
+            return v  # wire pytree: decoded lazily via tree_from_wire (needs template)
         return {k: _decode_value(x) for k, x in v.items()}
     if isinstance(v, list):
         return [_decode_value(x) for x in v]

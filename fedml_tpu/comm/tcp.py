@@ -19,6 +19,7 @@ the stale connection is dropped, and other nodes keep flowing.
 from __future__ import annotations
 
 import json
+import logging
 import socket
 import threading
 from typing import Dict, Optional
@@ -37,6 +38,10 @@ class TcpHub:
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()
         self._conns: Dict[int, socket.socket] = {}
+        # per-connection send locks: sendall on a multi-MB frame loops
+        # over partial sends, so two reader threads forwarding to the
+        # same receiver concurrently would interleave mid-payload
+        self._send_locks: Dict[int, threading.Lock] = {}
         self._lock = threading.Lock()
         self._running = True
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
@@ -62,6 +67,7 @@ class TcpHub:
             node_id = json.loads(hello)["node_id"]
             with self._lock:
                 self._conns[node_id] = conn
+                self._send_locks[node_id] = threading.Lock()
             conn.sendall((json.dumps(_ACK) + "\n").encode())
             while True:
                 line = f.readline()
@@ -81,7 +87,11 @@ class TcpHub:
         finally:
             if node_id is not None:
                 with self._lock:
-                    self._conns.pop(node_id, None)
+                    # identity guard: a re-registered node may have
+                    # replaced this conn; don't deregister the live one
+                    if self._conns.get(node_id) is conn:
+                        self._conns.pop(node_id, None)
+                        self._send_locks.pop(node_id, None)
             try:
                 conn.close()
             except OSError:
@@ -90,16 +100,21 @@ class TcpHub:
     def _forward(self, receiver: int, raw_line: bytes):
         with self._lock:
             conn = self._conns.get(receiver)
-        if conn is None:
+            send_lock = self._send_locks.get(receiver)
+        if conn is None or send_lock is None:
             return
         try:
-            conn.sendall(raw_line if raw_line.endswith(b"\n") else raw_line + b"\n")
+            with send_lock:
+                conn.sendall(
+                    raw_line if raw_line.endswith(b"\n") else raw_line + b"\n"
+                )
         except OSError:
             # dead receiver: unregister so later sends don't retry it;
             # its own reader thread finishes cleanup
             with self._lock:
                 if self._conns.get(receiver) is conn:
                     self._conns.pop(receiver, None)
+                    self._send_locks.pop(receiver, None)
 
     def stop(self):
         self._running = False
@@ -137,10 +152,20 @@ class TcpBackend(CommBackend):
             line = self._file.readline()
             if not line:
                 return
-            frame = json.loads(line)
+            try:
+                frame = json.loads(line)
+            except json.JSONDecodeError:
+                logging.exception("node %d: dropping malformed frame", self.node_id)
+                continue
             if frame.get("__hub__") == "stop":
                 return
-            self._notify(Message.from_json(line.decode()))
+            try:
+                self._notify(Message.from_json(line.decode()))
+            except Exception:
+                # a handler error must not kill the reader thread — the
+                # node would silently stop receiving and the federation
+                # would hang with no attributable cause
+                logging.exception("node %d: message handler failed", self.node_id)
 
     def run_in_thread(self) -> threading.Thread:
         t = threading.Thread(target=self.run, daemon=True)

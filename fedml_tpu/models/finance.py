@@ -1,0 +1,70 @@
+"""Finance / VFL party models.
+
+Reference: ``fedml_api/model/finance/`` —
+``vfl_feature_extractor.py:1-16`` (Dense → LeakyReLU),
+``vfl_classifier.py:1-12`` (single Dense logits head), and the
+standalone numpy/torch ``DenseModel``/``LocalModel`` pair
+(``vfl_models_standalone.py:6-34, 36-60``).
+
+Here each party's branch is one flax module (extractor + dense head
+fused — they always run back-to-back), so a vertical-FL party forward
+is a single MXU-friendly matmul chain and the whole multi-party step
+jits into one program.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+
+
+class VFLFeatureExtractor(nn.Module):
+    """Dense(out) → LeakyReLU (reference ``vfl_feature_extractor.py:4-16``)."""
+
+    output_dim: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.leaky_relu(nn.Dense(self.output_dim)(x))
+
+
+class VFLClassifier(nn.Module):
+    """Single Dense logits head (reference ``vfl_classifier.py:4-12``)."""
+
+    output_dim: int = 1
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        return nn.Dense(self.output_dim, use_bias=self.use_bias)(x)
+
+
+class VFLPartyNet(nn.Module):
+    """One vertical party's branch: extractor → logits head.
+
+    Mirrors the guest/host composition in the reference's standalone
+    party models (``party_models.py:14-34`` guest = LocalModel +
+    DenseModel; ``:95+`` host likewise): the party consumes its private
+    feature slice and emits [B, output_dim] logit components that the
+    guest sums.
+    """
+
+    feature_dim: int
+    output_dim: int = 1
+    use_bias: bool = True  # reference: guest dense has bias, hosts don't
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        h = VFLFeatureExtractor(self.feature_dim)(x)
+        return VFLClassifier(self.output_dim, use_bias=self.use_bias)(h)
+
+
+def vfl_party(input_dim: int, feature_dim: int, *, output_dim: int = 1,
+              use_bias: bool = True) -> ModelBundle:
+    return ModelBundle(
+        module=VFLPartyNet(feature_dim, output_dim, use_bias),
+        input_shape=(input_dim,),
+    )

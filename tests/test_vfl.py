@@ -1,0 +1,78 @@
+"""Vertical FL: protocol-vs-joint-autodiff oracle, learning, AUC."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from fedml_tpu.algorithms.vfl import (
+    VerticalFederation,
+    bce_with_logits,
+    binary_auc,
+    run_vfl,
+)
+from fedml_tpu.models.finance import vfl_party
+
+
+def _synthetic_vertical(n=512, dims=(6, 4, 5), seed=0):
+    rng = np.random.RandomState(seed)
+    xs = [rng.randn(n, d).astype(np.float32) for d in dims]
+    w = [rng.randn(d) for d in dims]
+    score = sum(x @ wi for x, wi in zip(xs, w))
+    y = (score > 0).astype(np.float32)
+    return xs, y
+
+
+def test_vfl_common_gradient_equals_joint_autodiff():
+    # The protocol (common grad dL/dU fanned through per-party vjps)
+    # must produce exactly the gradients of the joint loss.
+    dims = (3, 4)
+    xs, y = _synthetic_vertical(n=32, dims=dims, seed=1)
+    bundles = [vfl_party(d, 5) for d in dims]
+    fed = VerticalFederation(bundles, lr=0.1)
+    states = fed.init(jax.random.PRNGKey(0))
+
+    def joint_loss(all_params):
+        U = sum(
+            b.module.apply({"params": p}, jnp.asarray(x), train=True)
+            for b, p, x in zip(bundles, all_params, xs)
+        )
+        return bce_with_logits(U, jnp.asarray(y))
+
+    joint_grads = jax.grad(joint_loss)(tuple(st.params for st in states))
+
+    # one protocol step with plain SGD lr, no momentum/wd: the update is
+    # -lr * grad, so recover the protocol's gradient from the delta.
+    fed_plain = VerticalFederation(bundles, lr=1.0, momentum=0.0, weight_decay=0.0)
+    states0 = fed.init(jax.random.PRNGKey(0))
+    new_states, loss = fed_plain.fit(states0, [jnp.asarray(x) for x in xs], jnp.asarray(y))
+    for st0, st1, jg in zip(states0, new_states, joint_grads):
+        proto_grad = jax.tree_util.tree_map(lambda a, b: a - b, st0.params, st1.params)
+        chex_ok = jax.tree_util.tree_all(
+            jax.tree_util.tree_map(
+                lambda a, b: np.allclose(a, b, atol=1e-5), proto_grad, jg
+            )
+        )
+        assert chex_ok
+    assert np.isfinite(float(loss))
+
+
+def test_vfl_learns_separable():
+    dims = (6, 4, 5)
+    xs, y = _synthetic_vertical(dims=dims)
+    # guest has bias, hosts don't (reference party_models.py)
+    bundles = [vfl_party(dims[0], 8, use_bias=True)] + [
+        vfl_party(d, 8, use_bias=False) for d in dims[1:]
+    ]
+    fed = VerticalFederation(bundles, lr=0.05)
+    states, history = run_vfl(fed, xs, y, xs, y, epochs=12, batch_size=128)
+    assert history[-1]["accuracy"] > 0.9
+    assert history[-1]["auc"] > 0.95
+
+
+def test_binary_auc():
+    y = np.array([0, 0, 1, 1])
+    assert binary_auc(y, np.array([0.1, 0.2, 0.8, 0.9])) == 1.0
+    assert binary_auc(y, np.array([0.9, 0.8, 0.2, 0.1])) == 0.0
+    assert abs(binary_auc(y, np.array([0.5, 0.5, 0.5, 0.5])) - 0.5) < 1e-9

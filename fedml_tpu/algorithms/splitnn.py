@@ -1,0 +1,353 @@
+"""SplitNN — ring-relay split learning, TPU-native.
+
+Reference (SURVEY.md §2 row 16, §3.3): the client holds the bottom half
+of the network, the server the top.  Per mini-batch the client sends
+activations (``split_nn/client.py:24-30``), the server forwards them,
+computes CE loss, backprops, and returns ``acts.grad``
+(``server.py:40-59``); the client finishes the backward pass
+(``client.py:32-34``).  Exactly one client is active at a time; a
+semaphore token passes control around the ring
+(``client_manager.py:29-34``, ``message_define.py:6-16``), and the
+server rotates its active node + runs validation after each client's
+epoch (``server.py:61-75``).  Both halves use SGD(lr=0.1, momentum=0.9,
+wd=5e-4) (``client.py:18-19``, ``server.py:19-20``).
+
+TPU-native design: control crossing a process boundary twice per
+mini-batch is the worst possible fit for an accelerator, so on-device
+the boundary is *compiled away*: one jitted step computes the bottom
+forward as a ``jax.vjp``, the top forward/backward by autodiff, and
+feeds the activation cotangent — the exact tensor the reference ships
+back over MPI — straight into the bottom's vjp.  XLA fuses the whole
+thing; the "message" is an HBM-resident tensor.  The same step
+functions, split at that boundary (``bottom_forward`` /
+``top_step`` / ``bottom_backward``), also drive the message-mode
+managers below for true two-process deployments over the comm backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.comm.backend import CommBackend, NodeManager
+from fedml_tpu.comm.message import (
+    MSG_TYPE_C2C_SEMAPHORE,
+    MSG_TYPE_C2S_SEND_ACTS,
+    MSG_TYPE_S2C_FINISH,
+    MSG_TYPE_S2C_SEND_GRADS,
+    Message,
+    tree_from_wire,
+    tree_to_wire,
+)
+from fedml_tpu.core.losses import softmax_ce_logits
+from fedml_tpu.models.base import ModelBundle
+
+PyTree = Any
+SERVER = 0
+
+
+def split_optimizer(lr: float = 0.1, momentum: float = 0.9, weight_decay: float = 5e-4):
+    """Reference optimizer for both halves (``client.py:18-19``)."""
+    return optax.chain(
+        optax.add_decayed_weights(weight_decay),
+        optax.sgd(lr, momentum=momentum),
+    )
+
+
+class HalfState(NamedTuple):
+    params: PyTree
+    opt_state: Any
+
+
+def _apply(bundle: ModelBundle, params: PyTree, x: jax.Array, train: bool):
+    return bundle.module.apply({"params": params}, x, train=train)
+
+
+def make_split_steps(
+    bottom: ModelBundle,
+    top: ModelBundle,
+    opt: Optional[optax.GradientTransformation] = None,
+):
+    """Build (fused_step, bottom_forward, top_step, bottom_backward, evaluate).
+
+    ``fused_step`` is the on-device pipeline: both halves in one compiled
+    program.  The other three are the protocol-split pieces for
+    message-mode; feeding ``top_step``'s returned activation gradient to
+    ``bottom_backward`` reproduces ``fused_step`` exactly.
+    """
+    opt = opt or split_optimizer()
+
+    def bottom_forward(bstate: HalfState, x):
+        return _apply(bottom, bstate.params, x, True)
+
+    def top_step(tstate: HalfState, acts, y):
+        """Server side: forward top, CE loss, step, return act-grads."""
+
+        def loss_fn(tparams, acts):
+            logits = _apply(top, tparams, acts, True)
+            return softmax_ce_logits(logits, y).mean(), logits
+
+        (loss, logits), (gt, gacts) = jax.value_and_grad(
+            loss_fn, argnums=(0, 1), has_aux=True
+        )(tstate.params, acts)
+        updates, opt_state = opt.update(gt, tstate.opt_state, tstate.params)
+        new_t = HalfState(optax.apply_updates(tstate.params, updates), opt_state)
+        correct = (jnp.argmax(logits, -1) == y).sum()
+        return new_t, gacts, {"loss": loss, "correct": correct,
+                              "count": jnp.asarray(y.shape[0], jnp.float32)}
+
+    def bottom_backward(bstate: HalfState, x, gacts):
+        """Client side: backprop bottom with the server's act-grads."""
+        _, vjp = jax.vjp(lambda p: _apply(bottom, p, x, True), bstate.params)
+        (gb,) = vjp(gacts)
+        updates, opt_state = opt.update(gb, bstate.opt_state, bstate.params)
+        return HalfState(optax.apply_updates(bstate.params, updates), opt_state)
+
+    def fused_step(bstate: HalfState, tstate: HalfState, x, y):
+        acts, vjp = jax.vjp(lambda p: _apply(bottom, p, x, True), bstate.params)
+        new_t, gacts, metrics = top_step(tstate, acts, y)
+        (gb,) = vjp(gacts)
+        updates, opt_state = opt.update(gb, bstate.opt_state, bstate.params)
+        new_b = HalfState(optax.apply_updates(bstate.params, updates), opt_state)
+        return new_b, new_t, metrics
+
+    def evaluate(bstate: HalfState, tstate: HalfState, x, y):
+        logits = _apply(top, tstate.params, _apply(bottom, bstate.params, x, False), False)
+        loss = softmax_ce_logits(logits, y).mean()
+        correct = (jnp.argmax(logits, -1) == y).sum()
+        return {"loss": loss, "correct": correct,
+                "count": jnp.asarray(y.shape[0], jnp.float32)}
+
+    return fused_step, bottom_forward, top_step, bottom_backward, evaluate
+
+
+def init_half(bundle: ModelBundle, rng, opt=None) -> HalfState:
+    opt = opt or split_optimizer()
+    params = bundle.init(rng)["params"]
+    return HalfState(params, opt.init(params))
+
+
+@dataclasses.dataclass
+class SplitNNSimulation:
+    """Single-process ring driver (the reference's mpirun deployment,
+    compiled: SplitNNAPI.py:15-40 + the §3.3 call stack).
+
+    Each client owns a private bottom; the server owns the shared top.
+    Ring order = client id; after each client's epoch the server
+    validates and control passes on (``server.py:61-75``).
+    """
+
+    bottom: ModelBundle
+    top: ModelBundle
+    client_data: Sequence[Tuple[np.ndarray, np.ndarray]]  # per-client (x, y)
+    test_data: Tuple[np.ndarray, np.ndarray]
+    batch_size: int = 64
+    lr: float = 0.1
+    seed: int = 0
+
+    def __post_init__(self):
+        self.opt = split_optimizer(self.lr)
+        fused, _, _, _, evaluate = make_split_steps(self.bottom, self.top, self.opt)
+        self._step = jax.jit(fused)
+        self._eval = jax.jit(evaluate)
+        key = jax.random.PRNGKey(self.seed)
+        self.client_states: List[HalfState] = [
+            init_half(self.bottom, jax.random.fold_in(key, i + 1), self.opt)
+            for i in range(len(self.client_data))
+        ]
+        self.server_state = init_half(self.top, key, self.opt)
+        self.history: List[dict] = []
+        self.epoch = 0
+
+    def run_epoch(self) -> List[dict]:
+        """One ring pass: every client trains one local epoch in turn."""
+        out = []
+        for cid, (x, y) in enumerate(self.client_data):
+            rng = np.random.RandomState(self.seed * 7919 + self.epoch * 131 + cid)
+            order = rng.permutation(len(x))
+            tot = {"loss": 0.0, "correct": 0.0, "count": 0.0}
+            bstate = self.client_states[cid]
+            for lo in range(0, len(x) - self.batch_size + 1, self.batch_size):
+                sl = order[lo : lo + self.batch_size]
+                bstate, self.server_state, m = self._step(
+                    bstate, self.server_state, jnp.asarray(x[sl]), jnp.asarray(y[sl])
+                )
+                tot["loss"] += float(m["loss"]) * float(m["count"])
+                tot["correct"] += float(m["correct"])
+                tot["count"] += float(m["count"])
+            self.client_states[cid] = bstate
+            val = self.validate(cid)
+            rec = {
+                "epoch": self.epoch, "client": cid,
+                "train_acc": tot["correct"] / max(tot["count"], 1),
+                "train_loss": tot["loss"] / max(tot["count"], 1),
+                **{f"val_{k}": v for k, v in val.items()},
+            }
+            out.append(rec)
+            self.history.append(rec)
+        self.epoch += 1
+        return out
+
+    def validate(self, cid: int) -> dict:
+        x, y = self.test_data
+        m = {"loss": 0.0, "correct": 0.0, "count": 0.0}
+        for lo in range(0, len(x), 256):
+            r = self._eval(
+                self.client_states[cid], self.server_state,
+                jnp.asarray(x[lo : lo + 256]), jnp.asarray(y[lo : lo + 256]),
+            )
+            m["loss"] += float(r["loss"]) * float(r["count"])
+            m["correct"] += float(r["correct"])
+            m["count"] += float(r["count"])
+        return {"acc": m["correct"] / m["count"], "loss": m["loss"] / m["count"]}
+
+
+# --- message-mode managers (two-process deployments over CommBackend) -------
+
+class SplitNNServerManager(NodeManager):
+    """Holds the top half; answers every C2S_SEND_ACTS with S2C_SEND_GRADS
+    (reference ``server_manager.py:31-36``)."""
+
+    def __init__(self, backend: CommBackend, top: ModelBundle, *,
+                 acts_template, lr: float = 0.1, seed: int = 0):
+        self.opt = split_optimizer(lr)
+        _, _, top_step, _, _ = make_split_steps(
+            _DUMMY_BOTTOM, top, self.opt
+        )
+        self._top_step = jax.jit(top_step)
+        self.state = init_half(top, jax.random.PRNGKey(seed), self.opt)
+        self.acts_template = acts_template
+        self.batches_seen = 0
+        super().__init__(backend)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_TYPE_C2S_SEND_ACTS, self._on_acts)
+        self.register_message_receive_handler(MSG_TYPE_S2C_FINISH, self._on_finish)
+
+    def _on_finish(self, msg: Message):
+        self.finish()
+
+    def _on_acts(self, msg: Message):
+        acts = tree_from_wire(msg.get("acts"), self.acts_template)
+        y = np.asarray(msg.get("labels"), dtype=np.int32)
+        self.state, gacts, metrics = self._top_step(
+            self.state, jnp.asarray(acts), jnp.asarray(y)
+        )
+        self.batches_seen += 1
+        reply = Message(MSG_TYPE_S2C_SEND_GRADS, SERVER, msg.sender)
+        reply.add_params("grads", tree_to_wire(gacts))
+        reply.add_params("loss", float(metrics["loss"]))
+        self.send_message(reply)
+
+
+class SplitNNClientManager(NodeManager):
+    """Holds a bottom half + its private data; drives its epoch batch by
+    batch, then passes the semaphore to the next ring node
+    (reference ``client_manager.py:29-74``)."""
+
+    def __init__(self, backend: CommBackend, bottom: ModelBundle, x, y, *,
+                 node_id: int, next_node: int, batch_size: int = 64,
+                 lr: float = 0.1, active: bool = False, seed: int = 0,
+                 total_hops: int = 1):
+        self.opt = split_optimizer(lr)
+        _, bottom_forward, _, bottom_backward, _ = make_split_steps(
+            bottom, _DUMMY_TOP, self.opt
+        )
+        self._fwd = jax.jit(bottom_forward)
+        self._bwd = jax.jit(bottom_backward)
+        self.state = init_half(bottom, jax.random.PRNGKey(seed + node_id), self.opt)
+        self.x, self.y = x, y
+        self.batch_size = batch_size
+        self.next_node = next_node
+        self.active_at_start = active
+        self.batch_idx = 0
+        # ring budget: each epoch a client runs consumes one hop; the
+        # token retires when its countdown reaches zero (replaces the
+        # reference's MAX_EPOCH_PER_NODE bookkeeping, client.py:16)
+        self.hops_left = total_hops
+        self._acts_template = None
+        self._cur_x = None
+        super().__init__(backend)
+
+    def register_message_receive_handlers(self):
+        self.register_message_receive_handler(MSG_TYPE_S2C_SEND_GRADS, self._on_grads)
+        self.register_message_receive_handler(MSG_TYPE_C2C_SEMAPHORE, self._on_token)
+
+    def start_if_active(self):
+        if self.active_at_start:
+            self._send_next_batch()
+
+    def _on_token(self, msg: Message):
+        self.hops_left = msg.get("hops_left")
+        if self.hops_left <= 0:
+            # retire flood: finish, and keep it circling until it reaches
+            # the node that originated it — so every ring member AND the
+            # server get a shutdown, not just the first retiree (a real
+            # two-process deployment would otherwise hang on readline)
+            origin = msg.get("origin")
+            if self.next_node != origin:
+                self._forward_retire(origin)
+            self.finish()
+            return
+        self.batch_idx = 0
+        self._send_next_batch()
+
+    def _forward_retire(self, origin: int):
+        token = Message(MSG_TYPE_C2C_SEMAPHORE, self.backend.node_id, self.next_node)
+        token.add_params("hops_left", 0)
+        token.add_params("origin", origin)
+        self.send_message(token)
+
+    def _send_next_batch(self):
+        lo = self.batch_idx * self.batch_size
+        if lo + self.batch_size > len(self.x):
+            hops = self.hops_left - 1
+            if hops <= 0:
+                # token budget spent: tell the server, start the retire
+                # flood around the ring, then finish ourselves
+                self.send_message(
+                    Message(MSG_TYPE_S2C_FINISH, self.backend.node_id, SERVER)
+                )
+                if self.next_node != self.backend.node_id:
+                    self._forward_retire(self.backend.node_id)
+                self.finish()
+                return
+            # epoch done: pass the ring token (client_manager.py:72-74)
+            token = Message(
+                MSG_TYPE_C2C_SEMAPHORE, self.backend.node_id, self.next_node
+            )
+            token.add_params("hops_left", hops)
+            self.send_message(token)
+            return
+        sl = slice(lo, lo + self.batch_size)
+        self._cur_x = jnp.asarray(self.x[sl])
+        acts = self._fwd(self.state, self._cur_x)
+        self._acts_template = acts
+        m = Message(MSG_TYPE_C2S_SEND_ACTS, self.backend.node_id, SERVER)
+        m.add_params("acts", tree_to_wire(acts))
+        m.add_params("labels", np.asarray(self.y[sl]).tolist())
+        self.send_message(m)
+
+    def _on_grads(self, msg: Message):
+        gacts = jnp.asarray(tree_from_wire(msg.get("grads"), self._acts_template))
+        self.state = self._bwd(self.state, self._cur_x, gacts)
+        self.batch_idx += 1
+        self._send_next_batch()
+
+
+class _Identity:
+    """Placeholder half for managers that only own one side."""
+
+    class module:  # noqa: N801 — duck-typed ModelBundle.module
+        @staticmethod
+        def apply(variables, x, train=False):
+            return x
+
+
+_DUMMY_BOTTOM = _Identity()
+_DUMMY_TOP = _Identity()

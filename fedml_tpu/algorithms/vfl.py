@@ -44,9 +44,7 @@ class PartyState(NamedTuple):
 def bce_with_logits(logits: jax.Array, y: jax.Array) -> jax.Array:
     """Mean binary cross-entropy on logits (torch BCEWithLogitsLoss)."""
     y = y.reshape(logits.shape).astype(logits.dtype)
-    return jnp.mean(
-        jnp.clip(logits, 0) - logits * y + jnp.log1p(jnp.exp(-jnp.abs(logits)))
-    )
+    return optax.sigmoid_binary_cross_entropy(logits, y).mean()
 
 
 def _party_optimizer(lr: float, momentum: float = 0.9, weight_decay: float = 0.01):
@@ -158,10 +156,11 @@ def binary_auc(y_true: np.ndarray, score: np.ndarray) -> float:
     order = np.argsort(score, kind="mergesort")
     ranks = np.empty_like(order, dtype=np.float64)
     ranks[order] = np.arange(1, len(score) + 1)
-    # average ranks over ties
-    for s in np.unique(score):
-        m = score == s
-        ranks[m] = ranks[m].mean()
+    # tie-average in O(n log n): mean rank per unique score via bincount
+    _, inv = np.unique(score, return_inverse=True)
+    sums = np.bincount(inv, weights=ranks)
+    counts = np.bincount(inv)
+    ranks = (sums / counts)[inv]
     return float((ranks[y_true == 1].sum() - pos * (pos + 1) / 2) / (pos * neg))
 
 

@@ -79,7 +79,12 @@ class Message:
 
     @classmethod
     def from_json(cls, payload: str) -> "Message":
-        obj = json.loads(payload)
+        return cls.from_obj(json.loads(payload))
+
+    @classmethod
+    def from_obj(cls, obj: dict) -> "Message":
+        """Build from an already-parsed JSON dict (avoids re-parsing
+        multi-MB frames on hot receive paths)."""
         m = cls()
         m.params = {k: _decode_value(v) for k, v in obj.items()}
         return m

@@ -160,7 +160,7 @@ class TcpBackend(CommBackend):
             if frame.get("__hub__") == "stop":
                 return
             try:
-                self._notify(Message.from_json(line.decode()))
+                self._notify(Message.from_obj(frame))
             except Exception:
                 # a handler error must not kill the reader thread — the
                 # node would silently stop receiving and the federation

@@ -19,6 +19,17 @@ import jax.numpy as jnp
 LossFn = Callable[[jax.Array, jax.Array, jax.Array], Tuple[jax.Array, dict]]
 
 
+def softmax_ce_logits(logits: jax.Array, y: jax.Array) -> jax.Array:
+    """Per-example cross-entropy with integer targets (no mask) — the
+    plain ``nn.CrossEntropyLoss`` used where batches are full-shape
+    (SplitNN server, ``split_nn/server.py:21``)."""
+    import optax
+
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), y.astype(jnp.int32)
+    )
+
+
 def masked_softmax_ce(logits: jax.Array, y: jax.Array, mask: jax.Array):
     """Cross-entropy with integer targets; mean over mask.
 

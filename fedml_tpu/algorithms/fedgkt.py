@@ -1,0 +1,298 @@
+"""FedGKT — group knowledge transfer, TPU-native.
+
+Reference (SURVEY.md §2.2 row 15, §3.4): each edge client trains a small
+CNN locally with CE + α·KL against the server's last logits
+(``fedgkt/GKTClientTrainer.py:66-90``), then records per-batch feature
+maps + logits + labels and ships them to the server
+(``GKTClientTrainer.py:92-120``, ``GKTClientManager.py:39-48``); the
+server trains a large CNN on the stored features with CE + α·KL
+distillation from the client logits (``GKTServerTrainer.py:233-290``)
+and returns per-client server logits (``GKTServerManager.py:59+``).
+Activations — not weights — are the payload.
+
+TPU-native design: both phases are single compiled programs over
+fixed-shape packs.  The client phase maps over the packed client axis
+(local epochs scan + a feature-extraction scan); the server phase scans
+over (client × batch) slots with a per-slot mask, so heterogeneous
+client sizes cost no recompilation.  The feature/logit "messages" are
+just arrays handed between the two jits — on a mesh they become the
+sharded residents of the ``clients`` axis.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.core.client import make_client_optimizer
+from fedml_tpu.core.losses import masked_kd_kl, masked_softmax_ce
+from fedml_tpu.core.types import FedDataset, batch_eval_pack, pack_clients
+from fedml_tpu.models.base import ModelBundle
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class FedGKTConfig:
+    num_clients: int = 4
+    comm_rounds: int = 5
+    epochs_client: int = 1
+    epochs_server: int = 1
+    batch_size: int = 8
+    lr_client: float = 0.01
+    lr_server: float = 0.01
+    momentum: float = 0.9
+    weight_decay: float = 5e-4
+    temperature: float = 3.0   # reference --temperature default
+    alpha: float = 1.0         # KD loss weight, reference --alpha
+    whether_distill_on_client: bool = True
+    grad_clip: Optional[float] = 5.0
+    seed: int = 0
+
+
+class FedGKT:
+    """Two-net GKT driver: small client nets (one per client, stacked) +
+    one large server net; exchange = (features, logits, labels)."""
+
+    def __init__(
+        self,
+        client_bundle: ModelBundle,
+        server_bundle: ModelBundle,
+        dataset: FedDataset,
+        config: FedGKTConfig,
+    ):
+        self.cb = client_bundle
+        self.sb = server_bundle
+        self.ds = dataset
+        self.cfg = config
+
+        key = jax.random.PRNGKey(config.seed)
+        # K independent client models (GKT never averages them)
+        self.client_vars = jax.vmap(
+            lambda i: client_bundle.init(jax.random.fold_in(key, i))
+        )(jnp.arange(config.num_clients))
+        self.server_vars = server_bundle.init(jax.random.fold_in(key, 10**6))
+        self.server_opt = make_client_optimizer(
+            "sgd", config.lr_server, momentum=config.momentum,
+            weight_decay=config.weight_decay, grad_clip=config.grad_clip,
+        )
+        self.server_opt_state = self.server_opt.init(self.server_vars["params"])
+        # client optimizers persist across rounds (reference constructs
+        # them once in GKTClientTrainer.__init__); stacked like the models
+        self.client_opt = make_client_optimizer(
+            "sgd", config.lr_client, momentum=config.momentum,
+            weight_decay=config.weight_decay, grad_clip=config.grad_clip,
+        )
+        self.client_opt_states = self.client_opt.init(self.client_vars["params"])
+        self.key = key
+
+        # fixed pack geometry: every client padded to the max shard size
+        counts = dataset.client_sample_counts()
+        self.steps = max(1, int(np.ceil(max(int(counts.max()), 1) / config.batch_size)))
+        self.pack = pack_clients(
+            dataset, list(range(config.num_clients)), config.batch_size,
+            steps_per_epoch=self.steps, seed=config.seed,
+        )
+        self.num_classes = dataset.num_classes
+        # server logits start at zero => round-0 KD term vanishes only if
+        # alpha masked; reference round 0 trains clients without KD
+        self.server_logits = jnp.zeros(
+            (config.num_clients, self.steps, config.batch_size, self.num_classes),
+            jnp.float32,
+        )
+        self._test_pack = batch_eval_pack(
+            dataset.test_x, dataset.test_y, max(config.batch_size, 64)
+        )
+
+        self._client_phase = jax.jit(self._build_client_phase())
+        self._server_phase = jax.jit(self._build_server_phase())
+        self._eval_fn = jax.jit(self._build_eval())
+        self.round_idx = 0
+        self.history = []
+
+    # ---- client phase -------------------------------------------------
+    def _build_client_phase(self):
+        cfg = self.cfg
+        opt = self.client_opt
+
+        def loss_fn(params, others, bx, by, bm, s_logits, use_kd):
+            variables = {**others, "params": params}
+            (logits, _), new_vars = self.cb.apply_train(variables, bx)
+            ce, aux = masked_softmax_ce(logits, by, bm)
+            kd = masked_kd_kl(logits, s_logits, bm, cfg.temperature)
+            loss = ce + cfg.alpha * kd * use_kd
+            return loss, (new_vars, aux)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def one_client(variables, opt_state, x, y, mask, s_logits, use_kd):
+            def step(carry, batch):
+                variables, opt_state = carry
+                bx, by, bm, bl = batch
+                others = {k: v for k, v in variables.items() if k != "params"}
+                (_, (new_vars, aux)), grads = grad_fn(
+                    variables["params"], others, bx, by, bm, bl, use_kd
+                )
+                updates, opt_state = opt.update(grads, opt_state,
+                                                variables["params"])
+                params = optax.apply_updates(variables["params"], updates)
+                has_real = (bm.sum() > 0).astype(jnp.float32)
+                params = jax.tree_util.tree_map(
+                    lambda n, o: has_real * n + (1 - has_real) * o,
+                    params, variables["params"],
+                )
+                return ({**new_vars, "params": params}, opt_state), aux
+
+            def epoch(carry, _):
+                return jax.lax.scan(step, carry, (x, y, mask, s_logits))
+
+            (variables, opt_state), auxs = jax.lax.scan(
+                epoch, (variables, opt_state), jnp.arange(cfg.epochs_client)
+            )
+
+            # extraction pass: per-batch features + logits (eval mode,
+            # reference GKTClientTrainer.py:92-120 uses model.eval())
+            def extract(_, batch):
+                bx, _by = batch
+                logits, feats = self.cb.apply_eval(variables, bx)
+                return (), (feats, logits)
+
+            _, (feats, logits) = jax.lax.scan(extract, (), (x, y))
+            metrics = {k: v[-1].sum() for k, v in auxs.items()}
+            return variables, opt_state, feats, logits, metrics
+
+        def client_phase(client_vars, opt_states, x, y, mask, server_logits,
+                         use_kd):
+            return jax.lax.map(
+                lambda a: one_client(*a, use_kd),
+                (client_vars, opt_states, x, y, mask, server_logits),
+            )
+
+        return client_phase
+
+    # ---- server phase -------------------------------------------------
+    def _build_server_phase(self):
+        cfg = self.cfg
+
+        def loss_fn(params, others, bf, by, bm, c_logits):
+            variables = {**others, "params": params}
+            logits, new_vars = self.sb.apply_train(variables, bf)
+            ce, aux = masked_softmax_ce(logits, by, bm)
+            kd = masked_kd_kl(logits, c_logits, bm, cfg.temperature)
+            return ce + cfg.alpha * kd, (new_vars, aux)
+
+        grad_fn = jax.value_and_grad(loss_fn, has_aux=True)
+
+        def server_phase(server_vars, opt_state, feats, y, mask, c_logits):
+            # flatten (client, step) into one scan axis
+            K, S = y.shape[0], y.shape[1]
+            ff = feats.reshape(K * S, *feats.shape[2:])
+            yy = y.reshape(K * S, -1)
+            mm = mask.reshape(K * S, -1)
+            ll = c_logits.reshape(K * S, *c_logits.shape[2:])
+
+            def step(carry, batch):
+                variables, opt_state = carry
+                bf, by, bm, bl = batch
+                others = {k: v for k, v in variables.items() if k != "params"}
+                (_, (new_vars, aux)), grads = grad_fn(
+                    variables["params"], others, bf, by, bm, bl
+                )
+                updates, opt_state = self.server_opt.update(
+                    grads, opt_state, variables["params"]
+                )
+                params = optax.apply_updates(variables["params"], updates)
+                has_real = (bm.sum() > 0).astype(jnp.float32)
+                params = jax.tree_util.tree_map(
+                    lambda n, o: has_real * n + (1 - has_real) * o,
+                    params, variables["params"],
+                )
+                return ({**new_vars, "params": params}, opt_state), aux
+
+            def epoch(carry, _):
+                return jax.lax.scan(step, carry, (ff, yy, mm, ll))
+
+            (server_vars, opt_state), auxs = jax.lax.scan(
+                epoch, (server_vars, opt_state), jnp.arange(cfg.epochs_server)
+            )
+
+            # distill back: per-client server logits on stored features
+            def back(_, batch):
+                bf, = batch
+                return (), self.sb.apply_eval(server_vars, bf)
+
+            _, s_logits = jax.lax.scan(back, (), (ff,))
+            s_logits = s_logits.reshape(K, S, *s_logits.shape[1:])
+            metrics = {k: v[-1].sum() for k, v in auxs.items()}
+            return server_vars, opt_state, s_logits, metrics
+
+        return server_phase
+
+    # ---- end-to-end eval ----------------------------------------------
+    def _build_eval(self):
+        def evaluate(client_vars0, server_vars, x, y, mask):
+            # the reference evaluates the server model on features from
+            # client 0's extractor (GKTServerTrainer eval path)
+            def body(_, batch):
+                bx, by, bm = batch
+                _, feats = self.cb.apply_eval(client_vars0, bx)
+                logits = self.sb.apply_eval(server_vars, feats)
+                _, aux = masked_softmax_ce(logits, by, bm)
+                return (), aux
+
+            _, auxs = jax.lax.scan(body, (), (x, y, mask))
+            return {k: v.sum() for k, v in auxs.items()}
+
+        return evaluate
+
+    # ---- driver --------------------------------------------------------
+    def run_round(self) -> dict:
+        cfg = self.cfg
+        use_kd = jnp.asarray(
+            1.0 if (self.round_idx > 0 and cfg.whether_distill_on_client) else 0.0
+        )
+        x = jnp.asarray(self.pack.x)
+        y = jnp.asarray(self.pack.y)
+        mask = jnp.asarray(self.pack.mask)
+        (self.client_vars, self.client_opt_states, feats, c_logits, cm) = (
+            self._client_phase(
+                self.client_vars, self.client_opt_states, x, y, mask,
+                self.server_logits, use_kd,
+            )
+        )
+        (self.server_vars, self.server_opt_state, self.server_logits, sm) = (
+            self._server_phase(
+                self.server_vars, self.server_opt_state, feats, y, mask, c_logits
+            )
+        )
+        out = {
+            "round": self.round_idx,
+            "client_loss_sum": float(cm["loss_sum"].sum()),
+            "server_loss_sum": float(sm["loss_sum"]),
+            "server_train_acc": float(sm["correct"]) / max(float(sm["count"]), 1.0),
+        }
+        self.round_idx += 1
+        return out
+
+    def evaluate_global(self) -> dict:
+        tx, ty, tm = self._test_pack
+        cv0 = jax.tree_util.tree_map(lambda l: l[0], self.client_vars)
+        res = self._eval_fn(cv0, self.server_vars, jnp.asarray(tx),
+                            jnp.asarray(ty), jnp.asarray(tm))
+        count = max(float(res["count"]), 1.0)
+        return {
+            "test_acc": float(res["correct"]) / count,
+            "test_loss": float(res["loss_sum"]) / count,
+        }
+
+    def run(self, rounds: Optional[int] = None) -> list:
+        for _ in range(rounds if rounds is not None else self.cfg.comm_rounds):
+            m = self.run_round()
+            self.history.append(m)
+        self.history[-1].update(self.evaluate_global())
+        return self.history

@@ -53,9 +53,10 @@ SERVER = 0
 
 def split_optimizer(lr: float = 0.1, momentum: float = 0.9, weight_decay: float = 5e-4):
     """Reference optimizer for both halves (``client.py:18-19``)."""
-    return optax.chain(
-        optax.add_decayed_weights(weight_decay),
-        optax.sgd(lr, momentum=momentum),
+    from fedml_tpu.core.client import make_client_optimizer
+
+    return make_client_optimizer(
+        "sgd", lr, momentum=momentum, weight_decay=weight_decay
     )
 
 

@@ -88,7 +88,8 @@ def lcc_coded_sum(
     share_sum = mpc.field_sum(np.stack(enc, axis=0), p)  # [n, d/k]
     alive = [j for j in range(n) if j not in set(drop)]
     need = k + t  # decode degree: interpolation through K+T points
-    assert len(alive) >= need, f"too many stragglers: {len(alive)} < {need}"
+    if len(alive) < need:
+        raise ValueError(f"too many stragglers: {len(alive)} < {need}")
     use = alive[:need]
     # interpolating through K+T α-points recovers all K+T chunk rows of
     # the SUMMED polynomial; the first K rows are the data chunks
@@ -105,7 +106,6 @@ class TurboAggregateConfig:
     lr: float = 0.03
     scale: float = 2.0 ** 16
     seed: int = 0
-    frequency_of_the_test: int = 5
 
 
 class TurboAggregateSimulation:

@@ -60,6 +60,26 @@ def masked_bce_logits(logits: jax.Array, y: jax.Array, mask: jax.Array):
     return loss, {"loss_sum": (per * mask).sum(), "correct": correct, "count": mask.sum()}
 
 
+def masked_kd_kl(
+    student_logits: jax.Array,
+    teacher_logits: jax.Array,
+    mask: jax.Array,
+    temperature: float = 3.0,
+) -> jax.Array:
+    """Knowledge-distillation KL with temperature, mean over mask.
+
+    Matches the reference's ``KL_Loss`` (``fedgkt/utils.py``):
+    ``T² · KL(softmax(teacher/T) ‖ softmax(student/T))``.
+    """
+    t = temperature
+    logp_s = jax.nn.log_softmax(student_logits.astype(jnp.float32) / t, axis=-1)
+    p_t = jax.nn.softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    logp_t = jax.nn.log_softmax(teacher_logits.astype(jnp.float32) / t, axis=-1)
+    per = (p_t * (logp_t - logp_s)).sum(axis=-1) * (t * t)
+    denom = jnp.maximum(mask.sum(), 1.0)
+    return (per * mask).sum() / denom
+
+
 def masked_mse(preds: jax.Array, y: jax.Array, mask: jax.Array):
     preds = preds.astype(jnp.float32).reshape(y.shape)
     per = jnp.square(preds - y.astype(jnp.float32))

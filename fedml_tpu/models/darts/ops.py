@@ -1,0 +1,161 @@
+"""DARTS primitive operations in flax.
+
+Parity with the reference ``fedml_api/model/cv/darts/operations.py``:
+the 8-primitive OPS table (``operations.py:4-21``), ReLUConvBN
+(``:23-35``), SepConv (``:53-70``), DilConv (``:37-51``), Zero
+(``:81-91``) and FactorizedReduce (``:93-108``).  Search-stage
+BatchNorms are affine-free (``model_search.py:35-36`` passes
+``affine=False``), mirrored here with ``use_scale/use_bias=False``.
+
+TPU-first: NHWC; depthwise steps use ``feature_group_count``; every op
+keeps static shapes so a MixedOp's 8 branches fuse into one XLA
+computation.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+PRIMITIVES = (
+    "none",
+    "max_pool_3x3",
+    "avg_pool_3x3",
+    "skip_connect",
+    "sep_conv_3x3",
+    "sep_conv_5x5",
+    "dil_conv_3x3",
+    "dil_conv_5x5",
+)
+
+
+def _bn(train, affine):
+    return nn.BatchNorm(
+        use_running_average=not train, momentum=0.9, epsilon=1e-5,
+        use_scale=affine, use_bias=affine,
+    )
+
+
+class ReLUConvBN(nn.Module):
+    C_out: int
+    kernel: int = 1
+    stride: int = 1
+    affine: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        x = nn.Conv(self.C_out, (self.kernel, self.kernel),
+                    strides=self.stride, padding=self.kernel // 2,
+                    use_bias=False)(x)
+        return _bn(train, self.affine)(x)
+
+
+class SepConv(nn.Module):
+    C_out: int
+    kernel: int
+    stride: int
+    affine: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c_in = x.shape[-1]
+        pad = self.kernel // 2
+        for i, (stride, c_mid) in enumerate(((self.stride, c_in),
+                                             (1, self.C_out))):
+            x = nn.relu(x)
+            ch = x.shape[-1]
+            x = nn.Conv(ch, (self.kernel, self.kernel), strides=stride,
+                        padding=pad, feature_group_count=ch,
+                        use_bias=False)(x)
+            x = nn.Conv(c_mid, (1, 1), use_bias=False)(x)
+            x = _bn(train, self.affine)(x)
+        return x
+
+
+class DilConv(nn.Module):
+    C_out: int
+    kernel: int
+    stride: int
+    dilation: int = 2
+    affine: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        ch = x.shape[-1]
+        pad = (self.kernel - 1) * self.dilation // 2
+        x = nn.relu(x)
+        x = nn.Conv(ch, (self.kernel, self.kernel), strides=self.stride,
+                    padding=pad, kernel_dilation=self.dilation,
+                    feature_group_count=ch, use_bias=False)(x)
+        x = nn.Conv(self.C_out, (1, 1), use_bias=False)(x)
+        return _bn(train, self.affine)(x)
+
+
+class FactorizedReduce(nn.Module):
+    C_out: int
+    affine: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(x)
+        a = nn.Conv(self.C_out // 2, (1, 1), strides=2, use_bias=False)(x)
+        b = nn.Conv(self.C_out - self.C_out // 2, (1, 1), strides=2,
+                    use_bias=False)(x[:, 1:, 1:, :])
+        out = jnp.concatenate([a, b], axis=-1)
+        return _bn(train, self.affine)(out)
+
+
+class Pool(nn.Module):
+    kind: str  # "max" | "avg"
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.kind == "max":
+            return nn.max_pool(x, (3, 3), strides=(self.stride, self.stride),
+                               padding=((1, 1), (1, 1)))
+        # torch avg_pool count_include_pad=False semantics
+        ones = jnp.ones_like(x[..., :1])
+        s = nn.avg_pool(x, (3, 3), strides=(self.stride, self.stride),
+                        padding=((1, 1), (1, 1)))
+        n = nn.avg_pool(ones, (3, 3), strides=(self.stride, self.stride),
+                        padding=((1, 1), (1, 1)))
+        return s / jnp.maximum(n, 1e-12)
+
+
+class Zero(nn.Module):
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.stride == 1:
+            return jnp.zeros_like(x)
+        return jnp.zeros_like(x[:, ::self.stride, ::self.stride, :])
+
+
+class SkipConnect(nn.Module):
+    C_out: int
+    stride: int
+    affine: bool = True
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.stride == 1:
+            return x
+        return FactorizedReduce(self.C_out, affine=self.affine)(x, train)
+
+
+# primitive name -> module factory(C, stride, affine) — operations.py:4-21
+OPS: Dict[str, Callable] = {
+    "none": lambda C, stride, affine: Zero(stride),
+    "max_pool_3x3": lambda C, stride, affine: Pool("max", stride),
+    "avg_pool_3x3": lambda C, stride, affine: Pool("avg", stride),
+    "skip_connect": lambda C, stride, affine: SkipConnect(C, stride, affine),
+    "sep_conv_3x3": lambda C, stride, affine: SepConv(C, 3, stride, affine),
+    "sep_conv_5x5": lambda C, stride, affine: SepConv(C, 5, stride, affine),
+    "dil_conv_3x3": lambda C, stride, affine: DilConv(C, 3, stride, 2, affine),
+    "dil_conv_5x5": lambda C, stride, affine: DilConv(C, 5, stride, 2, affine),
+}

@@ -1,0 +1,183 @@
+"""EfficientNet-B0..B8.
+
+Architecture parity with the reference
+``fedml_api/model/cv/efficientnet.py`` (MBConvBlock ``:36-135``,
+EfficientNet ``:138-303``) and its utils (compound-scaling table
+``efficientnet_utils.py:430-450``, default block args decoded from the
+r/k/s/e/i/o/se strings at ``:453-520``, round_filters/round_repeats
+``:81-110``): swish activation, SE with 1×1 convs, drop-connect that
+scales linearly with block depth, stem 32 → head 1280.
+
+TPU-first: NHWC; static Python loop over blocks (traced once);
+stochastic depth implemented with an explicit rng; grouped conv for the
+depthwise step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockArgs:
+    num_repeat: int
+    kernel_size: int
+    stride: int
+    expand_ratio: int
+    input_filters: int
+    output_filters: int
+    se_ratio: float = 0.25
+
+
+# decoded form of the reference's default block strings
+# (efficientnet_utils.py:469-478)
+DEFAULT_BLOCKS = (
+    BlockArgs(1, 3, 1, 1, 32, 16),
+    BlockArgs(2, 3, 2, 6, 16, 24),
+    BlockArgs(2, 5, 2, 6, 24, 40),
+    BlockArgs(3, 3, 2, 6, 40, 80),
+    BlockArgs(3, 5, 1, 6, 80, 112),
+    BlockArgs(4, 5, 2, 6, 112, 192),
+    BlockArgs(1, 3, 1, 6, 192, 320),
+)
+
+# name -> (width_coeff, depth_coeff, resolution, dropout)
+# (efficientnet_utils.py:437-448)
+PARAMS = {
+    "efficientnet-b0": (1.0, 1.0, 224, 0.2),
+    "efficientnet-b1": (1.0, 1.1, 240, 0.2),
+    "efficientnet-b2": (1.1, 1.2, 260, 0.3),
+    "efficientnet-b3": (1.2, 1.4, 300, 0.3),
+    "efficientnet-b4": (1.4, 1.8, 380, 0.4),
+    "efficientnet-b5": (1.6, 2.2, 456, 0.4),
+    "efficientnet-b6": (1.8, 2.6, 528, 0.5),
+    "efficientnet-b7": (2.0, 3.1, 600, 0.5),
+    "efficientnet-b8": (2.2, 3.6, 672, 0.5),
+}
+
+
+def round_filters(filters: int, width_coeff: float, divisor: int = 8) -> int:
+    """Reference ``efficientnet_utils.py:81-101`` — the same divisor
+    rounding as MobileNetV3's ``make_divisible``."""
+    from fedml_tpu.models.mobilenet_v3 import make_divisible
+
+    return make_divisible(filters * width_coeff, divisor)
+
+
+def round_repeats(repeats: int, depth_coeff: float) -> int:
+    return int(math.ceil(depth_coeff * repeats))
+
+
+def _bn(train):
+    # reference: bn momentum 0.99, eps 1e-3 (efficientnet_utils.py global params)
+    return nn.BatchNorm(use_running_average=not train, momentum=0.99,
+                        epsilon=1e-3)
+
+
+def drop_connect(x, rate, deterministic, rng):
+    """Per-sample stochastic depth (reference ``efficientnet_utils.py``
+    drop_connect)."""
+    if deterministic or rate == 0.0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, (x.shape[0], 1, 1, 1))
+    return x * mask / keep
+
+
+class MBConvBlock(nn.Module):
+    """Mobile inverted residual bottleneck + SE (reference ``efficientnet.py:36-135``)."""
+
+    kernel_size: int
+    stride: int
+    expand_ratio: int
+    output_filters: int
+    se_ratio: float
+    drop_rate: float = 0.0
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        inputs = x
+        in_ch = x.shape[-1]
+        mid = in_ch * self.expand_ratio
+        if self.expand_ratio != 1:
+            x = nn.Conv(mid, (1, 1), use_bias=False)(x)
+            x = nn.swish(_bn(train)(x))
+        x = nn.Conv(mid, (self.kernel_size, self.kernel_size),
+                    strides=self.stride, padding="SAME",
+                    feature_group_count=mid, use_bias=False)(x)
+        x = nn.swish(_bn(train)(x))
+        if 0 < self.se_ratio <= 1:
+            squeezed = max(1, int(in_ch * self.se_ratio))
+            s = jnp.mean(x, axis=(1, 2), keepdims=True)
+            s = nn.swish(nn.Conv(squeezed, (1, 1))(s))
+            s = nn.sigmoid(nn.Conv(mid, (1, 1))(s))
+            x = x * s
+        x = nn.Conv(self.output_filters, (1, 1), use_bias=False)(x)
+        x = _bn(train)(x)
+        if self.stride == 1 and in_ch == self.output_filters:
+            rng = (self.make_rng("dropout")
+                   if train and self.drop_rate > 0 and self.has_rng("dropout")
+                   else None)
+            x = drop_connect(x, self.drop_rate, not train, rng)
+            x = x + inputs
+        return x
+
+
+class EfficientNet(nn.Module):
+    width_coeff: float = 1.0
+    depth_coeff: float = 1.0
+    dropout_rate: float = 0.2
+    drop_connect_rate: float = 0.2
+    num_classes: int = 1000
+    blocks_args: Sequence[BlockArgs] = DEFAULT_BLOCKS
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(round_filters(32, self.width_coeff), (3, 3), strides=2,
+                    padding="SAME", use_bias=False)(x)
+        x = nn.swish(_bn(train)(x))
+
+        total_blocks = sum(
+            round_repeats(b.num_repeat, self.depth_coeff)
+            for b in self.blocks_args
+        )
+        idx = 0
+        for b in self.blocks_args:
+            out = round_filters(b.output_filters, self.width_coeff)
+            for rep in range(round_repeats(b.num_repeat, self.depth_coeff)):
+                x = MBConvBlock(
+                    kernel_size=b.kernel_size,
+                    stride=b.stride if rep == 0 else 1,
+                    expand_ratio=b.expand_ratio,
+                    output_filters=out,
+                    se_ratio=b.se_ratio,
+                    # linear depth scaling, reference efficientnet.py:193-196
+                    drop_rate=self.drop_connect_rate * idx / total_blocks,
+                )(x, train)
+                idx += 1
+        x = nn.Conv(round_filters(1280, self.width_coeff), (1, 1),
+                    use_bias=False)(x)
+        x = nn.swish(_bn(train)(x))
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def efficientnet(name: str = "efficientnet-b0", num_classes: int = 1000,
+                 image_size: Optional[int] = None) -> ModelBundle:
+    """Reference factory ``EfficientNet.from_name`` (``efficientnet.py:305-325``)."""
+    w, d, res, dropout = PARAMS[name]
+    return ModelBundle(
+        module=EfficientNet(width_coeff=w, depth_coeff=d, dropout_rate=dropout,
+                            num_classes=num_classes),
+        input_shape=(image_size or res, image_size or res, 3),
+        needs_dropout_rng=True,
+    )

@@ -1,0 +1,80 @@
+"""MobileNet-v1 with a width multiplier.
+
+Architecture parity with the reference ``fedml_api/model/cv/mobilenet.py``:
+stem = 3×3 conv(32α) + depthwise-separable(64α) (``mobilenet.py:74-83``),
+then four downsample stages 128α/256α/512α(×6)/1024α
+(``mobilenet.py:86-205``), global average pool, linear head.  Used in the
+benchmark table (MobileNet CIFAR rows, BASELINE.md).
+
+TPU-first: NHWC; depthwise conv = ``nn.Conv(feature_group_count=C)``
+which XLA lowers to an MXU-friendly grouped convolution.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+
+
+def _bn(train):
+    return nn.BatchNorm(use_running_average=not train, momentum=0.9, epsilon=1e-5)
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: int = 3
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(self.features, (self.kernel, self.kernel),
+                    strides=self.stride, padding=1, use_bias=False)(x)
+        return nn.relu(_bn(train)(x))
+
+
+class DepthwiseSeparable(nn.Module):
+    """Depthwise 3×3 + BN + relu, pointwise 1×1 + BN + relu
+    (reference ``mobilenet.py:7-36``)."""
+
+    features: int
+    stride: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        in_ch = x.shape[-1]
+        x = nn.Conv(in_ch, (3, 3), strides=self.stride, padding=1,
+                    feature_group_count=in_ch, use_bias=False)(x)
+        x = nn.relu(_bn(train)(x))
+        x = nn.Conv(self.features, (1, 1), use_bias=False)(x)
+        return nn.relu(_bn(train)(x))
+
+
+class MobileNet(nn.Module):
+    width_multiplier: float = 1.0
+    num_classes: int = 100
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        a = self.width_multiplier
+        c = lambda ch: int(ch * a)
+        x = ConvBN(c(32))(x, train)
+        x = DepthwiseSeparable(c(64))(x, train)
+        # stage plan from reference mobilenet.py:86-205
+        for planes, blocks in ((128, 2), (256, 2), (512, 6), (1024, 2)):
+            for i in range(blocks):
+                x = DepthwiseSeparable(c(planes), stride=2 if i == 0 else 1)(
+                    x, train
+                )
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+def mobilenet(num_classes=100, width_multiplier=1.0, image_size=32):
+    """Reference factory ``mobilenet(class_num=...)`` (``mobilenet.py:208-210``)."""
+    return ModelBundle(
+        module=MobileNet(width_multiplier=width_multiplier,
+                         num_classes=num_classes),
+        input_shape=(image_size, image_size, 3),
+    )

@@ -1,0 +1,118 @@
+"""Split ResNets for group knowledge transfer (FedGKT).
+
+Architecture parity with the reference
+``fedml_api/model/cv/resnet56_gkt/``:
+
+- client nets (``resnet_client.py:206-240``): CIFAR stem (3×3 conv →
+  BN → relu) whose output is the **extracted feature map** shipped to
+  the server, followed by layer1 only, global pool and a local head;
+  ``resnet5_56`` = BasicBlock×1, ``resnet8_56`` = Bottleneck×2.
+- server net (``resnet_server.py:113-190``): consumes the 16-channel
+  feature map directly (its stem is disabled, ``resnet_server.py:186-189``),
+  runs the full three stages, pool, head; ``resnet56_server`` =
+  Bottleneck [6,6,6], ``resnet110_server`` = Bottleneck [12,12,12].
+
+Both client and server return ``(logits, features)`` /
+``logits`` respectively; the FedGKT algorithm exchanges features and
+logits, never weights (SURVEY.md §2.2 row 15).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+from fedml_tpu.models.resnet import BasicBlock, Bottleneck, _norm
+
+
+class GKTClientResNet(nn.Module):
+    block: type
+    n_blocks: int
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(16, (3, 3), padding=1, use_bias=False)(x)
+        x = _norm(train)(x)
+        x = nn.relu(x)
+        features = x  # B×H×W×16 — the FedGKT payload
+        for _ in range(self.n_blocks):
+            x = self.block(planes=16, stride=1)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        logits = nn.Dense(self.num_classes)(x)
+        return logits, features
+
+
+class GKTServerResNet(nn.Module):
+    layers: Sequence[int]
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        # input is the client's 16-channel feature map; no stem
+        for stage, (planes, n_blocks) in enumerate(zip((16, 32, 64), self.layers)):
+            for i in range(n_blocks):
+                stride = 2 if (stage > 0 and i == 0) else 1
+                x = Bottleneck(planes=planes, stride=stride)(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes)(x)
+
+
+@dataclasses.dataclass
+class GKTClientBundle(ModelBundle):
+    """ModelBundle whose forward returns (logits, features)."""
+
+    def apply_train(self, variables, x, rng=None):
+        if "batch_stats" in variables:
+            (logits, feats), mutated = self.module.apply(
+                variables, x, train=True, mutable=["batch_stats"]
+            )
+            return (logits, feats), {**variables,
+                                     "batch_stats": mutated["batch_stats"]}
+        out = self.module.apply(variables, x, train=True)
+        return out, variables
+
+    def apply_eval(self, variables, x):
+        return self.module.apply(variables, x, train=False)
+
+
+def resnet5_56(num_classes=10, image_size=32) -> GKTClientBundle:
+    """Reference: ResNet(BasicBlock, [1,2,2]) with only layer1 active
+    (``resnet_client.py:206-216``)."""
+    return GKTClientBundle(
+        module=GKTClientResNet(block=BasicBlock, n_blocks=1,
+                               num_classes=num_classes),
+        input_shape=(image_size, image_size, 3),
+    )
+
+
+def resnet8_56(num_classes=10, image_size=32) -> GKTClientBundle:
+    """Reference: ResNet(Bottleneck, [2,2,2]) with only layer1 active
+    (``resnet_client.py:232-240``)."""
+    return GKTClientBundle(
+        module=GKTClientResNet(block=Bottleneck, n_blocks=2,
+                               num_classes=num_classes),
+        input_shape=(image_size, image_size, 3),
+    )
+
+
+def _server_bundle(layers, num_classes, image_size):
+    # server input spec is the FEATURE map, 16 channels at stem resolution
+    return ModelBundle(
+        module=GKTServerResNet(layers=layers, num_classes=num_classes),
+        input_shape=(image_size, image_size, 16),
+    )
+
+
+def resnet56_server(num_classes=10, image_size=32) -> ModelBundle:
+    """Reference: ResNet(Bottleneck, [6,6,6]) (``resnet_server.py`` factory)."""
+    return _server_bundle((6, 6, 6), num_classes, image_size)
+
+
+def resnet110_server(num_classes=10, image_size=32) -> ModelBundle:
+    return _server_bundle((12, 12, 12), num_classes, image_size)

@@ -1,0 +1,118 @@
+"""VGG-11/13/16/19 (plain and BatchNorm variants).
+
+Architecture parity with the reference ``fedml_api/model/cv/vgg.py``:
+torchvision-style config strings (``vgg.py:73-78`` cfgs A/B/D/E), a
+7×7 adaptive average pool, and the 4096-4096 dropout classifier head
+(``vgg.py:22-31``).  Factories ``vgg11..vgg19`` / ``*_bn``
+(``vgg.py:82-160``).
+
+TPU-first choices: NHWC, a statically-unrolled adaptive pool (bins are
+computed at trace time — no dynamic shapes under jit), dropout driven by
+an explicit rng.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+
+# torchvision layer configs: reference vgg.py:73-78
+CFGS = {
+    "A": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "B": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "D": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"),
+    "E": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512,
+          512, "M", 512, 512, 512, 512, "M"),
+}
+
+
+def adaptive_avg_pool(x: jnp.ndarray, out_hw: int) -> jnp.ndarray:
+    """torch AdaptiveAvgPool2d semantics on NHWC, unrolled statically.
+
+    Bin i covers [floor(i*H/out), ceil((i+1)*H/out)); shapes are static
+    under jit so the 49-slice unroll traces once and XLA fuses it.
+    """
+    h, w = x.shape[1], x.shape[2]
+    if h == out_hw and w == out_hw:
+        return x
+    rows = []
+    for i in range(out_hw):
+        h0, h1 = (i * h) // out_hw, -(-((i + 1) * h) // out_hw)
+        cols = []
+        for j in range(out_hw):
+            w0, w1 = (j * w) // out_hw, -(-((j + 1) * w) // out_hw)
+            cols.append(x[:, h0:h1, w0:w1, :].mean(axis=(1, 2)))
+        rows.append(jnp.stack(cols, axis=1))
+    return jnp.stack(rows, axis=1)
+
+
+class VGG(nn.Module):
+    cfg: Sequence[Union[int, str]]
+    batch_norm: bool = False
+    num_classes: int = 1000
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(int(v), (3, 3), padding=1)(x)
+                if self.batch_norm:
+                    x = nn.BatchNorm(
+                        use_running_average=not train, momentum=0.9, epsilon=1e-5
+                    )(x)
+                x = nn.relu(x)
+        x = adaptive_avg_pool(x, 7)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(4096)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        return nn.Dense(self.num_classes)(x)
+
+
+def _bundle(cfg_key, batch_norm, num_classes, image_size):
+    return ModelBundle(
+        module=VGG(cfg=CFGS[cfg_key], batch_norm=batch_norm,
+                   num_classes=num_classes),
+        input_shape=(image_size, image_size, 3),
+        needs_dropout_rng=True,
+    )
+
+
+def vgg11(num_classes=1000, image_size=224):
+    return _bundle("A", False, num_classes, image_size)
+
+
+def vgg11_bn(num_classes=1000, image_size=224):
+    return _bundle("A", True, num_classes, image_size)
+
+
+def vgg13(num_classes=1000, image_size=224):
+    return _bundle("B", False, num_classes, image_size)
+
+
+def vgg13_bn(num_classes=1000, image_size=224):
+    return _bundle("B", True, num_classes, image_size)
+
+
+def vgg16(num_classes=1000, image_size=224):
+    return _bundle("D", False, num_classes, image_size)
+
+
+def vgg16_bn(num_classes=1000, image_size=224):
+    return _bundle("D", True, num_classes, image_size)
+
+
+def vgg19(num_classes=1000, image_size=224):
+    return _bundle("E", False, num_classes, image_size)
+
+
+def vgg19_bn(num_classes=1000, image_size=224):
+    return _bundle("E", True, num_classes, image_size)

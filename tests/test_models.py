@@ -1,0 +1,97 @@
+"""Model-zoo shape/forward tests (tiny inputs, CPU mesh harness).
+
+Mirrors the reference's only model sanity check
+(``fedml_api/model/cv/test_cnn.py``) but covers every family in
+SURVEY.md §2.4, asserting init + train/eval forward shapes and finite
+outputs.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _run(bundle, batch=2, num_classes=None):
+    variables = bundle.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((batch, *bundle.input_shape), bundle.input_dtype)
+    logits = bundle.apply_eval(variables, x)
+    out, new_vars = bundle.apply_train(variables, x, jax.random.PRNGKey(1))
+    return logits, out, new_vars
+
+
+def test_vgg11_bn_tiny():
+    from fedml_tpu.models.vgg import vgg11_bn
+
+    b = vgg11_bn(num_classes=7, image_size=32)
+    logits, out, new_vars = _run(b)
+    assert logits.shape == (2, 7)
+    assert np.isfinite(np.asarray(logits)).all()
+    assert "batch_stats" in new_vars
+
+
+def test_vgg_adaptive_pool_matches_mean():
+    from fedml_tpu.models.vgg import adaptive_avg_pool
+
+    x = jnp.arange(2 * 14 * 14 * 3, dtype=jnp.float32).reshape(2, 14, 14, 3)
+    y = adaptive_avg_pool(x, 7)
+    assert y.shape == (2, 7, 7, 3)
+    # 14 -> 7 is exact 2x2 mean pooling
+    ref = x.reshape(2, 7, 2, 7, 2, 3).mean(axis=(2, 4))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-6)
+
+
+def test_mobilenet_v1():
+    from fedml_tpu.models.mobilenet import mobilenet
+
+    b = mobilenet(num_classes=5, width_multiplier=0.25, image_size=32)
+    logits, _, _ = _run(b)
+    assert logits.shape == (2, 5)
+
+
+def test_mobilenet_v3_small():
+    from fedml_tpu.models.mobilenet_v3 import mobilenet_v3
+
+    b = mobilenet_v3(num_classes=6, model_mode="SMALL", multiplier=0.5,
+                     image_size=32)
+    logits, _, _ = _run(b)
+    assert logits.shape == (2, 6)
+    assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_efficientnet_b0_tiny():
+    from fedml_tpu.models.efficientnet import efficientnet
+
+    b = efficientnet("efficientnet-b0", num_classes=4, image_size=32)
+    logits, out, _ = _run(b)
+    assert logits.shape == (2, 4)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_efficientnet_scaling_table():
+    from fedml_tpu.models.efficientnet import PARAMS, round_filters
+
+    assert PARAMS["efficientnet-b7"] == (2.0, 3.1, 600, 0.5)
+    # width scaling with divisor-8 rounding
+    assert round_filters(32, 1.0) == 32
+    assert round_filters(32, 1.1) == 32  # divisor-8 rounding of 35.2
+    assert round_filters(32, 1.4) == 48
+
+
+def test_gkt_split_resnets_compose():
+    from fedml_tpu.models.resnet_gkt import resnet8_56, resnet56_server
+
+    client = resnet8_56(num_classes=3, image_size=8)
+    server = resnet56_server(num_classes=3, image_size=8)
+    cvars = client.init(jax.random.PRNGKey(0))
+    svars = server.init(jax.random.PRNGKey(1))
+    x = jnp.zeros((2, 8, 8, 3))
+    logits, feats = client.apply_eval(cvars, x)
+    assert logits.shape == (2, 3)
+    assert feats.shape == (2, 8, 8, 16)  # stem resolution, 16 channels
+    server_logits = server.apply_eval(svars, feats)
+    assert server_logits.shape == (2, 3)
+    # train-mode path returns ((logits, feats), new_vars)
+    (tl, tf), new_vars = client.apply_train(cvars, x)
+    assert tl.shape == (2, 3) and tf.shape == feats.shape
+    assert "batch_stats" in new_vars

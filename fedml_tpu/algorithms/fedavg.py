@@ -180,6 +180,7 @@ class FedAvgSimulation:
         server_opt_init: Optional[Callable[[PyTree], Any]] = None,
         aggregate_transform: Optional[Callable] = None,
         local_update: Optional[LocalUpdateFn] = None,
+        augment_fn: Optional[Callable] = None,
     ):
         self.bundle = bundle
         self.dataset = dataset
@@ -198,6 +199,7 @@ class FedAvgSimulation:
             config.epochs,
             loss_fn,
             prox_mu=config.prox_mu,
+            augment_fn=augment_fn,
         )
         self._server_update = server_update
         self._aggregate_transform = aggregate_transform

@@ -1,0 +1,282 @@
+"""FedNAS — federated DARTS architecture search, TPU-native.
+
+Reference (SURVEY.md §2.2 row 14): clients alternate architecture
+(alpha) and weight optimization per batch — ``Architect.step_v2``
+updates alphas with grad = ∇α L_valid + λ_train·∇α L_train
+(``darts/architect.py:58-103``), then SGD steps the weights with
+grad-clip 5 (``FedNASTrainer.local_search``, ``FedNASTrainer.py:82-115``);
+the server averages BOTH weights and alphas sample-weighted
+(``FedNASAggregator.py:56-87``).  Two stages: ``search`` then ``train``
+on the derived genotype (``main_fednas.py:44-45``).
+
+TPU-native: one jitted round = lax.map over the packed client axis;
+each client runs a scan over (epochs × batches) where every step does
+the alpha Adam update followed by the weight SGD update — the bilevel
+alternation becomes two value_and_grads inside one fused step, no
+Python in the loop.  Weights and alphas are separate pytrees (see
+``models/darts/search.py``), so "average both" is the same masked
+weighted tree-mean applied twice.  The train stage reuses the FedAvg
+engine on the fixed network — no duplicated round logic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from fedml_tpu.algorithms.fedavg import FedAvgConfig, FedAvgSimulation
+from fedml_tpu.core.losses import masked_softmax_ce
+from fedml_tpu.core.types import FedDataset, batch_eval_pack, pack_clients
+from fedml_tpu.models.darts.genotypes import Genotype, genotype_from_alphas
+from fedml_tpu.models.darts.network import darts_network
+from fedml_tpu.models.darts.search import SearchBundle
+
+PyTree = Any
+
+
+@dataclasses.dataclass
+class FedNASConfig:
+    num_clients: int = 4
+    clients_per_round: int = 4
+    comm_rounds: int = 5
+    epochs: int = 1               # local search epochs per round
+    batch_size: int = 8
+    lr: float = 0.025             # weight SGD (reference --learning_rate)
+    momentum: float = 0.9
+    weight_decay: float = 3e-4    # reference --weight_decay
+    grad_clip: float = 5.0        # reference --grad_clip
+    arch_lr: float = 3e-4         # reference --arch_learning_rate
+    arch_weight_decay: float = 1e-3
+    lambda_train_regularizer: float = 1.0   # reference --lambda_train_regularizer
+    seed: int = 0
+
+
+class SearchState(NamedTuple):
+    variables: PyTree    # network weights (+batch_stats)
+    alphas: PyTree       # {"alphas_normal", "alphas_reduce"}
+    round_idx: jax.Array
+    key: jax.Array
+
+
+class FedNASSearch:
+    """Search-stage driver: federated bilevel optimization of
+    (weights, alphas)."""
+
+    def __init__(self, bundle: SearchBundle, dataset: FedDataset,
+                 config: FedNASConfig):
+        self.bundle = bundle
+        self.ds = dataset
+        self.cfg = config
+
+        key = jax.random.PRNGKey(config.seed)
+        self.state = SearchState(
+            variables=bundle.init(key),
+            alphas=bundle.init_alphas(jax.random.fold_in(key, 1)),
+            round_idx=jnp.zeros((), jnp.int32),
+            key=key,
+        )
+        counts = dataset.client_sample_counts()
+        self.steps = max(1, int(np.ceil(max(int(counts.max()), 1)
+                                        / config.batch_size)))
+        self._round_fn = jax.jit(self._build_round_fn())
+        self._test_pack = batch_eval_pack(
+            dataset.test_x, dataset.test_y, max(config.batch_size, 64)
+        )
+        self._eval_fn = jax.jit(self._build_eval())
+        self.history = []
+
+    def _build_round_fn(self):
+        from fedml_tpu.core.client import make_client_optimizer
+
+        cfg = self.cfg
+        bundle = self.bundle
+        w_opt = make_client_optimizer(
+            "sgd", cfg.lr, momentum=cfg.momentum,
+            weight_decay=cfg.weight_decay, grad_clip=cfg.grad_clip,
+        )
+        # reference Architect: Adam(arch_lr, betas=(0.5, 0.999), wd)
+        a_opt = optax.chain(
+            optax.clip_by_global_norm(cfg.grad_clip),
+            optax.add_decayed_weights(cfg.arch_weight_decay),
+            optax.adam(cfg.arch_lr, b1=0.5, b2=0.999),
+        )
+
+        def w_loss(params, others, alphas, bx, by, bm):
+            variables = {**others, "params": params}
+            logits, new_vars = bundle.apply_train(variables, alphas, bx)
+            loss, aux = masked_softmax_ce(logits, by, bm)
+            return loss, (new_vars, aux)
+
+        def a_loss(alphas, variables, bx, by, bm):
+            logits, _ = bundle.apply_train(variables, alphas, bx)
+            loss, _ = masked_softmax_ce(logits, by, bm)
+            return loss
+
+        w_grad = jax.value_and_grad(w_loss, has_aux=True)
+        a_grad = jax.grad(a_loss)
+
+        def one_client(variables, alphas, x, y, m, vx, vy, vm):
+            n_valid = vx.shape[0]
+            w_state = w_opt.init(variables["params"])
+            a_state = a_opt.init(alphas)
+
+            def step(carry, batch):
+                variables, alphas, w_state, a_state = carry
+                bx, by, bm, bi = batch
+                # a random-with-replacement valid batch per train step
+                # (reference local_search: next(iter(valid_queue)))
+                vi = bi % n_valid
+                bvx, bvy, bvm = vx[vi], vy[vi], vm[vi]
+                old_alphas = alphas
+                # architect step_v2: g_val + λ_train · g_train
+                g_train = a_grad(alphas, variables, bx, by, bm)
+                g_val = a_grad(alphas, variables, bvx, bvy, bvm)
+                g = jax.tree_util.tree_map(
+                    lambda gv, gt: gv + cfg.lambda_train_regularizer * gt,
+                    g_val, g_train,
+                )
+                a_up, a_state = a_opt.update(g, a_state, alphas)
+                alphas = optax.apply_updates(alphas, a_up)
+                # weight step
+                others = {k: v for k, v in variables.items() if k != "params"}
+                (_, (new_vars, aux)), gw = w_grad(
+                    variables["params"], others, alphas, bx, by, bm
+                )
+                w_up, w_state = w_opt.update(gw, w_state, variables["params"])
+                params = optax.apply_updates(variables["params"], w_up)
+                # pad-only batches must leave weights AND alphas untouched
+                has_real = (bm.sum() > 0).astype(jnp.float32)
+                params, alphas = jax.tree_util.tree_map(
+                    lambda n, o: has_real * n + (1 - has_real) * o,
+                    (params, alphas), (variables["params"], old_alphas),
+                )
+                return ({**new_vars, "params": params}, alphas, w_state,
+                        a_state), aux
+
+            def epoch(carry, _):
+                return jax.lax.scan(
+                    step, carry, (x, y, m, jnp.arange(x.shape[0]))
+                )
+
+            (variables, alphas, _, _), auxs = jax.lax.scan(
+                epoch, (variables, alphas, w_state, a_state),
+                jnp.arange(cfg.epochs),
+            )
+            metrics = {k: v[-1].sum() for k, v in auxs.items()}
+            return variables, alphas, metrics
+
+        def round_fn(state: SearchState, x, y, m, vx, vy, vm, num_samples):
+            c_vars, c_alphas, c_metrics = jax.lax.map(
+                lambda a: one_client(state.variables, state.alphas, *a),
+                (x, y, m, vx, vy, vm),
+            )
+            w = num_samples / jnp.maximum(num_samples.sum(), 1e-12)
+            avg = lambda tree: jax.tree_util.tree_map(
+                lambda leaf: jnp.einsum(
+                    "k,k...->...", w, leaf.astype(jnp.float32)
+                ).astype(leaf.dtype),
+                tree,
+            )
+            new_state = SearchState(
+                variables=avg(c_vars),
+                alphas=avg(c_alphas),
+                round_idx=state.round_idx + 1,
+                key=state.key,
+            )
+            metrics = {k: v.sum() for k, v in c_metrics.items()}
+            return new_state, metrics
+
+        return round_fn
+
+    def _build_eval(self):
+        def evaluate(variables, alphas, x, y, m):
+            def body(_, batch):
+                bx, by, bm = batch
+                logits = self.bundle.apply_eval(variables, alphas, bx)
+                _, aux = masked_softmax_ce(logits, by, bm)
+                return (), aux
+
+            _, auxs = jax.lax.scan(body, (), (x, y, m))
+            return {k: v.sum() for k, v in auxs.items()}
+
+        return evaluate
+
+    def run_round(self) -> dict:
+        cfg = self.cfg
+        round_idx = int(self.state.round_idx)
+        if cfg.clients_per_round < cfg.num_clients:
+            rng = np.random.RandomState(cfg.seed * 100003 + round_idx)
+            ids = sorted(rng.choice(cfg.num_clients, cfg.clients_per_round,
+                                    replace=False).tolist())
+        else:
+            ids = list(range(cfg.num_clients))
+        pack = pack_clients(self.ds, ids, cfg.batch_size,
+                            steps_per_epoch=self.steps,
+                            seed=cfg.seed + round_idx)
+        # architect's valid queue = the client's local test shard
+        # (reference passes test_local as valid_queue); fall back to the
+        # client's train shard when no per-client test partition exists
+        if self.ds.test_client_idx is not None:
+            vds = FedDataset(
+                train_x=self.ds.test_x, train_y=self.ds.test_y,
+                test_x=self.ds.test_x, test_y=self.ds.test_y,
+                train_client_idx=self.ds.test_client_idx,
+                test_client_idx=None, num_classes=self.ds.num_classes,
+            )
+            vpack = pack_clients(vds, ids, cfg.batch_size,
+                                 seed=cfg.seed + round_idx + 7)
+        else:
+            vpack = pack_clients(self.ds, ids, cfg.batch_size,
+                                 steps_per_epoch=self.steps,
+                                 seed=cfg.seed + round_idx + 7)
+        self.state, metrics = self._round_fn(
+            self.state,
+            jnp.asarray(pack.x), jnp.asarray(pack.y), jnp.asarray(pack.mask),
+            jnp.asarray(vpack.x), jnp.asarray(vpack.y), jnp.asarray(vpack.mask),
+            jnp.asarray(pack.num_samples),
+        )
+        out = {"round": round_idx,
+               **{k: float(v) for k, v in metrics.items()}}
+        if out.get("count", 0) > 0:
+            out["train_acc"] = out["correct"] / out["count"]
+        return out
+
+    def evaluate_global(self) -> dict:
+        x, y, m = self._test_pack
+        res = self._eval_fn(self.state.variables, self.state.alphas,
+                            jnp.asarray(x), jnp.asarray(y), jnp.asarray(m))
+        c = max(float(res["count"]), 1.0)
+        return {"test_acc": float(res["correct"]) / c,
+                "test_loss": float(res["loss_sum"]) / c}
+
+    def genotype(self) -> Genotype:
+        """Discrete architecture from the aggregated alphas
+        (reference ``model_search.py:258-297``)."""
+        return genotype_from_alphas(
+            np.asarray(self.state.alphas["alphas_normal"]),
+            np.asarray(self.state.alphas["alphas_reduce"]),
+            steps=self.bundle.module.steps,
+            multiplier=self.bundle.module.multiplier,
+        )
+
+    def run(self, rounds: Optional[int] = None) -> list:
+        for _ in range(rounds if rounds is not None else self.cfg.comm_rounds):
+            self.history.append(self.run_round())
+        self.history[-1].update(self.evaluate_global())
+        return self.history
+
+
+def fednas_train_stage(
+    genotype: Genotype, dataset: FedDataset, config: FedAvgConfig,
+    *, C: int = 36, layers: int = 20, image_size: int = 32,
+) -> FedAvgSimulation:
+    """Stage 2 (``--stage train``): plain federated training of the fixed
+    network — the FedAvg engine on the derived genotype."""
+    bundle = darts_network(genotype, C=C, num_classes=dataset.num_classes,
+                           layers=layers, image_size=image_size)
+    return FedAvgSimulation(bundle, dataset, config)

@@ -1,0 +1,121 @@
+"""Checkpoint/resume for federated training state.
+
+The reference has NO training-state checkpointing — only static
+pretrained weight loading at model construction
+(``model/cv/resnet.py:202-224``; SURVEY.md §5.4).  Here the full round
+state — (global variables, server optimizer state, round index, RNG
+key) — is one explicit pytree, so persistence is orbax on that tree:
+resume == load + continue, bit-identical.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+PyTree = Any
+
+
+def _flatten_for_npz(tree: PyTree) -> dict:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    out = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
+    out["__treedef__"] = np.frombuffer(
+        repr(treedef).encode(), dtype=np.uint8
+    )
+    return out
+
+
+class CheckpointManager:
+    """Orbax-backed checkpoint manager with an npz fallback.
+
+    Usage::
+
+        mgr = CheckpointManager(dir, max_to_keep=3)
+        mgr.save(step, state)           # state: any pytree (e.g. ServerState)
+        state = mgr.restore(like=state) # latest step, template for structure
+        mgr.latest_step()
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 3):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self._mgr = None
+        try:
+            import orbax.checkpoint as ocp
+
+            self._ocp = ocp
+            self._mgr = ocp.CheckpointManager(
+                self.directory,
+                options=ocp.CheckpointManagerOptions(
+                    max_to_keep=max_to_keep, create=True
+                ),
+            )
+        except Exception:
+            self._ocp = None  # npz fallback
+
+    # ---- orbax path ---------------------------------------------------
+    def save(self, step: int, state: PyTree) -> None:
+        state = jax.tree_util.tree_map(np.asarray, state)
+        if self._mgr is not None:
+            self._mgr.save(
+                step, args=self._ocp.args.StandardSave(state)
+            )
+            self._mgr.wait_until_finished()
+            return
+        np.savez(
+            os.path.join(self.directory, f"ckpt_{step}.npz"),
+            **_flatten_for_npz(state),
+        )
+        self._gc_npz()
+
+    def latest_step(self) -> Optional[int]:
+        if self._mgr is not None:
+            return self._mgr.latest_step()
+        steps = self._npz_steps()
+        return max(steps) if steps else None
+
+    def restore(self, like: PyTree, step: Optional[int] = None) -> PyTree:
+        """Restore ``step`` (default: latest) with ``like`` as the
+        structure/dtype template."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        template = jax.tree_util.tree_map(np.asarray, like)
+        if self._mgr is not None:
+            restored = self._mgr.restore(
+                step, args=self._ocp.args.StandardRestore(template)
+            )
+        else:
+            z = np.load(os.path.join(self.directory, f"ckpt_{step}.npz"))
+            leaves, treedef = jax.tree_util.tree_flatten(template)
+            saved_def = bytes(z["__treedef__"]).decode()
+            if saved_def != repr(treedef):
+                raise ValueError(
+                    "checkpoint tree structure does not match the restore "
+                    f"template:\n saved: {saved_def}\n template: {treedef!r}"
+                )
+            restored = jax.tree_util.tree_unflatten(
+                treedef, [z[f"leaf_{i}"] for i in range(len(leaves))]
+            )
+        # match the template's leaf dtypes/types (jnp arrays where needed)
+        return jax.tree_util.tree_map(
+            lambda tpl, val: np.asarray(val, dtype=np.asarray(tpl).dtype),
+            like, restored,
+        )
+
+    # ---- npz fallback helpers ----------------------------------------
+    def _npz_steps(self):
+        return [
+            int(f[len("ckpt_"):-len(".npz")])
+            for f in os.listdir(self.directory)
+            if f.startswith("ckpt_") and f.endswith(".npz")
+        ]
+
+    def _gc_npz(self):
+        steps = sorted(self._npz_steps())
+        for s in steps[: -self.max_to_keep]:
+            os.remove(os.path.join(self.directory, f"ckpt_{s}.npz"))

@@ -78,6 +78,7 @@ def make_local_update(
     *,
     prox_mu: float = 0.0,
     shuffle: bool = True,
+    augment_fn: Optional[Callable] = None,
 ) -> LocalUpdateFn:
     """Build the pure local-update function for one client.
 
@@ -110,7 +111,7 @@ def make_local_update(
             if shuffle:
                 perm = jax.random.permutation(jax.random.fold_in(ek, 0), n)
                 xs = x.reshape(n, *x.shape[2:])[perm].reshape(x.shape)
-                ys = y.reshape(n)[perm].reshape(y.shape)
+                ys = y.reshape(n, *y.shape[2:])[perm].reshape(y.shape)
                 ms = mask.reshape(n)[perm].reshape(mask.shape)
             else:
                 xs, ys, ms = x, y, mask
@@ -119,6 +120,10 @@ def make_local_update(
                 variables, opt_state = carry
                 bx, by, bm, bi = batch
                 sk = jax.random.fold_in(ek, bi + 1)
+                if augment_fn is not None:
+                    # fresh augmentation per (epoch, step) — the role of the
+                    # reference's per-epoch torchvision transforms
+                    bx = augment_fn(jax.random.fold_in(sk, 0), bx)
                 others = {k: v for k, v in variables.items() if k != "params"}
                 (loss, (new_vars, aux)), grads = grad_fn(
                     variables["params"], others, global_params, bx, by, bm, sk

@@ -1,0 +1,105 @@
+"""Structured metrics, named timing spans, and profiler hooks.
+
+The reference logs manual wall-clock spans to wandb/python-logging
+scattered through the code (SURVEY.md §5.1/§5.5: aggregate time
+``FedAVGAggregator.py:59,85-86``, message send span
+``FedAvgServerManager.py:93-102``, client compute time
+``MyModelTrainer.py:42,66-71``, round wall-clock
+``FedAVGAggregator.py:100-101,154``).  Here one sink owns all of it:
+
+- ``MetricsLogger``: ``log(dict)`` → JSON-lines file + python logging
+  + optional wandb, with the standard keys (round/epoch/spans).
+- ``span(name)``: context manager producing the same named spans as the
+  reference (``time_aggregate``, ``time_round``, ...).
+- ``trace(dir)``: ``jax.profiler`` trace context for TPU timelines.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+logger = logging.getLogger("fedml_tpu")
+
+
+class MetricsLogger:
+    def __init__(
+        self,
+        run_dir: Optional[str] = None,
+        use_wandb: bool = False,
+        wandb_kwargs: Optional[dict] = None,
+    ):
+        self.run_dir = run_dir
+        self._fh = None
+        if run_dir:
+            os.makedirs(run_dir, exist_ok=True)
+            self._fh = open(os.path.join(run_dir, "metrics.jsonl"), "a")
+        self._wandb = None
+        if use_wandb:
+            try:
+                import wandb
+
+                if wandb.run is None:
+                    wandb.init(**(wandb_kwargs or {}))
+                self._wandb = wandb
+            except Exception:
+                logger.warning("wandb requested but unavailable; file/log only")
+        self.spans: Dict[str, float] = {}
+
+    def log(self, metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+        record = dict(metrics)
+        if step is not None:
+            record.setdefault("round", step)
+        if self.spans:
+            record.update({f"time_{k}": v for k, v in self.spans.items()})
+            self.spans = {}
+        record.setdefault("ts", time.time())
+        logger.info("metrics %s", json.dumps(record, default=float))
+        if self._fh:
+            self._fh.write(json.dumps(record, default=float) + "\n")
+            self._fh.flush()
+        if self._wandb:
+            self._wandb.log(record, step=step)
+
+    @contextlib.contextmanager
+    def span(self, name: str):
+        """Named wall-clock span, attached to the next ``log`` call —
+        the reference's manual time-logging pattern, centralized."""
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.spans[name] = self.spans.get(name, 0.0) + (
+                time.perf_counter() - t0
+            )
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/fedml_tpu_trace"):
+    """``jax.profiler`` trace context (open with TensorBoard/XProf)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def setup_logging(rank: Optional[int] = None, level=logging.INFO) -> None:
+    """Per-process format including the process rank — reference
+    ``main_fedavg.py:286-289``."""
+    tag = f"[rank {rank}] " if rank is not None else ""
+    logging.basicConfig(
+        level=level,
+        format=f"%(asctime)s {tag}%(name)s %(levelname)s: %(message)s",
+    )

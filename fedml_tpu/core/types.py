@@ -166,7 +166,12 @@ def pack_clients(
             mask = np.zeros(total, dtype=np.float32)
             mask[: min(n, total)] = 1.0
         xs.append(dataset.train_x[wrapped].reshape(steps_per_epoch, batch_size, *feat_shape))
-        ys.append(dataset.train_y[wrapped].reshape(steps_per_epoch, batch_size))
+        # y may carry trailing dims (sequence targets [N, T], tag vectors)
+        ys.append(
+            dataset.train_y[wrapped].reshape(
+                steps_per_epoch, batch_size, *dataset.train_y.shape[1:]
+            )
+        )
         ms.append(mask.reshape(steps_per_epoch, batch_size))
         ns.append(min(n, total))
 
@@ -193,6 +198,6 @@ def batch_eval_pack(
     mask[:n] = 1.0
     return (
         x[idx].reshape(steps, batch_size, *x.shape[1:]),
-        y[idx].reshape(steps, batch_size),
+        y[idx].reshape(steps, batch_size, *y.shape[1:]),
         mask.reshape(steps, batch_size),
     )

@@ -1,0 +1,74 @@
+"""Jit-compiled image augmentation.
+
+The reference applies torchvision transforms per sample on the host
+(``fedml_api/data_preprocessing/cifar10/data_loader.py:57-99``:
+RandomCrop(32, padding=4), RandomHorizontalFlip, normalize, Cutout(16)).
+Host-side per-sample python transforms would serialize the input
+pipeline; here the same augmentations are a vectorized jax function
+applied to each [B, H, W, C] batch inside the compiled local-update
+step (see ``core.client.make_local_update(augment_fn=...)``), so they
+fuse with the forward pass and cost no host↔device traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def make_image_augment(
+    pad: int = 4,
+    flip: bool = True,
+    cutout: Optional[int] = 16,
+) -> Callable:
+    """Returns ``augment(rng, x)`` for x [B, H, W, C] (already normalized).
+
+    Random crop via pad+dynamic_slice, horizontal flip via mask-select,
+    Cutout via a clipped square mask — all batched and jit-safe.
+    """
+
+    def augment(rng, x):
+        B, H, W, C = x.shape
+        k_crop, k_flip, k_cut = jax.random.split(rng, 3)
+
+        if pad:
+            xp = jnp.pad(
+                x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
+            )
+            offs = jax.random.randint(k_crop, (B, 2), 0, 2 * pad + 1)
+
+            def crop_one(img, off):
+                return jax.lax.dynamic_slice(
+                    img, (off[0], off[1], 0), (H, W, C)
+                )
+
+            x = jax.vmap(crop_one)(xp, offs)
+
+        if flip:
+            do = jax.random.bernoulli(k_flip, 0.5, (B, 1, 1, 1))
+            x = jnp.where(do, x[:, :, ::-1, :], x)
+
+        if cutout:
+            cy = jax.random.randint(k_cut, (B,), 0, H)
+            cx = jax.random.randint(jax.random.fold_in(k_cut, 1), (B,), 0, W)
+            ys = jnp.arange(H)[None, :, None]
+            xs = jnp.arange(W)[None, None, :]
+            half = cutout // 2
+            inside = (
+                (ys >= (cy[:, None, None] - half))
+                & (ys < (cy[:, None, None] + half))
+                & (xs >= (cx[:, None, None] - half))
+                & (xs < (cx[:, None, None] + half))
+            )
+            x = x * (1.0 - inside[..., None].astype(x.dtype))
+
+        return x
+
+    return augment
+
+
+def cifar_augment() -> Callable:
+    """The reference CIFAR recipe: crop(pad 4) + flip + Cutout(16)."""
+    return make_image_augment(pad=4, flip=True, cutout=16)

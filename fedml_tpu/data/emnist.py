@@ -1,0 +1,91 @@
+"""Federated EMNIST (FEMNIST) and fed_CIFAR100 — TFF h5 natural-user
+partitions.
+
+Reference: ``fedml_api/data_preprocessing/FederatedEMNIST/data_loader.py``
+(h5 files ``fed_emnist_train.h5``/``fed_emnist_test.h5`` with an
+``examples/<client_id>/{pixels,label}`` group per writer, 3400 clients,
+62 classes) and ``fed_cifar100/data_loader.py:17-21`` (500 train / 100
+test clients, ``image``/``label`` keys).  Natural partition = one h5
+group per client; no synthetic re-partitioning is applied when real
+files exist.  Offline fallback: synthetic stand-ins with the same
+shapes and client counts (scaled down).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from fedml_tpu.core.types import FedDataset
+from fedml_tpu.data.synthetic import synthetic_classification
+
+
+def _load_h5_clients(path: str, x_key: str, y_key: str):
+    import h5py
+
+    xs, ys, idx = [], [], {}
+    off = 0
+    with h5py.File(path, "r") as f:
+        ex = f["examples"]
+        for c, cid in enumerate(sorted(ex.keys())):
+            g = ex[cid]
+            x = np.asarray(g[x_key])
+            y = np.asarray(g[y_key], np.int32)
+            xs.append(x)
+            ys.append(y)
+            idx[c] = np.arange(off, off + len(y))
+            off += len(y)
+    return np.concatenate(xs), np.concatenate(ys), idx
+
+
+def load_femnist(
+    data_dir: str = "./data/FederatedEMNIST/datasets",
+    num_clients: int = 3400,
+    only_digits: bool = False,
+    seed: int = 0,
+) -> FedDataset:
+    tr = os.path.join(data_dir, "fed_emnist_train.h5")
+    te = os.path.join(data_dir, "fed_emnist_test.h5")
+    classes = 10 if only_digits else 62
+    if os.path.exists(tr) and os.path.exists(te):
+        train_x, train_y, train_idx = _load_h5_clients(tr, "pixels", "label")
+        test_x, test_y, test_idx = _load_h5_clients(te, "pixels", "label")
+        if train_x.ndim == 3:
+            train_x, test_x = train_x[..., None], test_x[..., None]
+        return FedDataset(
+            train_x=train_x.astype(np.float32), train_y=train_y,
+            test_x=test_x.astype(np.float32), test_y=test_y,
+            train_client_idx=train_idx, test_client_idx=test_idx,
+            num_classes=classes, name="femnist",
+        )
+    return synthetic_classification(
+        num_train=min(num_clients, 100) * 60,
+        num_test=min(num_clients, 100) * 10,
+        input_shape=(28, 28, 1), num_classes=classes,
+        num_clients=min(num_clients, 100), partition="power_law", seed=seed,
+        name="femnist(synthetic-standin)",
+    )
+
+
+def load_fed_cifar100(
+    data_dir: str = "./data/fed_cifar100/datasets",
+    seed: int = 0,
+) -> FedDataset:
+    tr = os.path.join(data_dir, "fed_cifar100_train.h5")
+    te = os.path.join(data_dir, "fed_cifar100_test.h5")
+    if os.path.exists(tr) and os.path.exists(te):
+        train_x, train_y, train_idx = _load_h5_clients(tr, "image", "label")
+        test_x, test_y, test_idx = _load_h5_clients(te, "image", "label")
+        return FedDataset(
+            train_x=train_x.astype(np.float32) / 255.0, train_y=train_y,
+            test_x=test_x.astype(np.float32) / 255.0, test_y=test_y,
+            train_client_idx=train_idx, test_client_idx=test_idx,
+            num_classes=100, name="fed_cifar100",
+        )
+    return synthetic_classification(
+        num_train=50 * 100, num_test=50 * 20, input_shape=(24, 24, 3),
+        num_classes=100, num_clients=50, partition="homo", seed=seed,
+        name="fed_cifar100(synthetic-standin)",
+    )

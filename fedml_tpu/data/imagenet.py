@@ -1,0 +1,81 @@
+"""ImageNet (ILSVRC2012) and Google Landmarks (gld23k/gld160k)
+federated loaders.
+
+Reference: ``fedml_api/data_preprocessing/ImageNet/data_loader.py``
+(folder tree, 1000 classes, uniform client split) and ``Landmarks/``
+(CSV mapping ``user_id → image file``: natural per-photographer
+partition, 233 clients for gld23k).  Raw JPEG decoding needs PIL which
+this offline build treats as optional: when a preprocessed ``.npz``
+(``x_train/y_train/x_test/y_test`` [+ ``user_train`` client ids]) is
+present it is used, otherwise a synthetic stand-in with matching
+geometry is returned.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+
+from fedml_tpu.core.partition import partition_data
+from fedml_tpu.core.types import FedDataset
+from fedml_tpu.data.synthetic import synthetic_classification
+
+
+def _from_npz(path: str, num_classes: int, num_clients: int, name: str,
+              seed: int) -> FedDataset:
+    z = np.load(path)
+    train_x = z["x_train"].astype(np.float32)
+    train_y = z["y_train"].astype(np.int32)
+    test_x = z["x_test"].astype(np.float32)
+    test_y = z["y_test"].astype(np.int32)
+    if "user_train" in z:
+        users = np.asarray(z["user_train"])
+        idx = {
+            c: np.where(users == u)[0]
+            for c, u in enumerate(np.unique(users))
+        }
+    else:
+        idx = partition_data(train_y, num_clients, "homo", 0.5, seed)
+    return FedDataset(
+        train_x=train_x, train_y=train_y, test_x=test_x, test_y=test_y,
+        train_client_idx=idx, test_client_idx=None,
+        num_classes=num_classes, name=name,
+    )
+
+
+def load_imagenet(
+    data_dir: str = "./data/ImageNet",
+    num_clients: int = 100,
+    image_size: int = 224,
+    seed: int = 0,
+) -> FedDataset:
+    path = os.path.join(data_dir, "imagenet_federated.npz")
+    if os.path.exists(path):
+        return _from_npz(path, 1000, num_clients, "imagenet", seed)
+    return synthetic_classification(
+        num_train=num_clients * 16, num_test=64,
+        input_shape=(image_size, image_size, 3), num_classes=1000,
+        num_clients=num_clients, partition="homo", seed=seed,
+        name="imagenet(synthetic-standin)",
+    )
+
+
+def load_landmarks(
+    data_dir: str = "./data/gld",
+    variant: str = "gld23k",   # 233 clients / 203 classes (reference)
+    image_size: int = 224,
+    seed: int = 0,
+) -> FedDataset:
+    num_clients, num_classes = (233, 203) if variant == "gld23k" else (1262, 2028)
+    path = os.path.join(data_dir, f"{variant}_federated.npz")
+    if os.path.exists(path):
+        return _from_npz(path, num_classes, num_clients, variant, seed)
+    small = min(num_clients, 50)
+    return synthetic_classification(
+        num_train=small * 12, num_test=48,
+        input_shape=(image_size, image_size, 3), num_classes=num_classes,
+        num_clients=small, partition="power_law", seed=seed,
+        name=f"{variant}(synthetic-standin)",
+    )

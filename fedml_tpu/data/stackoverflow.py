@@ -1,0 +1,137 @@
+"""StackOverflow federated datasets: next-word prediction (NWP) and
+tag prediction (logistic regression, LR).
+
+Reference: ``fedml_api/data_preprocessing/stackoverflow_nwp/data_loader.py``
+(h5, 342 477 users, 10 000-word vocab + pad/bos/eos/oov → 10 004,
+20-token windows) and ``stackoverflow_lr/data_loader.py`` (bag-of-words
+10 000 features, 500 tags, multi-label).  Offline fallback: synthetic
+stand-ins with matching shapes; the NWP stand-in uses a vocab random
+walk so next-token structure is learnable.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import numpy as np
+
+from fedml_tpu.core.types import FedDataset
+
+NWP_VOCAB = 10000
+NWP_EXTENDED = NWP_VOCAB + 4  # pad/bos/eos/oov, reference rnn.py:39-47
+NWP_SEQ_LEN = 20
+LR_FEATURES = 10000
+LR_TAGS = 500
+
+
+def load_stackoverflow_nwp(
+    data_dir: str = "./data/stackoverflow/datasets",
+    num_clients: int = 10,
+    sequences_per_client: int = 32,
+    seed: int = 0,
+) -> FedDataset:
+    h5path = os.path.join(data_dir, "stackoverflow_nwp.pkl")
+    tr = os.path.join(data_dir, "stackoverflow_train.h5")
+    if os.path.exists(tr):
+        import h5py
+
+        xs, ys, idx = [], [], {}
+        off = 0
+        with h5py.File(tr, "r") as f:
+            ex = f["examples"]
+            for c, cid in enumerate(sorted(ex.keys())[: num_clients or None]):
+                toks = np.asarray(ex[cid]["tokens"])  # already int windows
+                kept = 0
+                for row in toks:
+                    row = np.asarray(row, np.int32)[: NWP_SEQ_LEN + 1]
+                    if len(row) < 2:
+                        continue
+                    pad = NWP_SEQ_LEN + 1 - len(row)
+                    row = np.pad(row, (0, pad))
+                    xs.append(row[:-1])
+                    ys.append(row[1:])
+                    kept += 1
+                idx[c] = np.arange(off, off + kept)
+                off += kept
+        return FedDataset(
+            train_x=np.stack(xs).astype(np.int32),
+            train_y=np.stack(ys).astype(np.int32),
+            test_x=np.stack(xs[:64]).astype(np.int32),
+            test_y=np.stack(ys[:64]).astype(np.int32),
+            train_client_idx=idx, test_client_idx=None,
+            num_classes=NWP_EXTENDED, name="stackoverflow_nwp",
+        )
+    del h5path
+    rng = np.random.RandomState(seed)
+
+    def block(n):
+        steps = rng.randint(-50, 51, size=n * (NWP_SEQ_LEN + 1))
+        ids = (np.cumsum(steps) % NWP_VOCAB + 4).astype(np.int32)
+        ids = ids.reshape(n, NWP_SEQ_LEN + 1)
+        return ids[:, :-1], ids[:, 1:]
+
+    xs, ys, idx = [], [], {}
+    off = 0
+    for c in range(num_clients):
+        x, y = block(sequences_per_client)
+        xs.append(x)
+        ys.append(y)
+        idx[c] = np.arange(off, off + len(x))
+        off += len(x)
+    tx, ty = block(64)
+    return FedDataset(
+        train_x=np.concatenate(xs), train_y=np.concatenate(ys),
+        test_x=tx, test_y=ty, train_client_idx=idx, test_client_idx=None,
+        num_classes=NWP_EXTENDED, name="stackoverflow_nwp(synthetic-standin)",
+    )
+
+
+def load_stackoverflow_lr(
+    data_dir: str = "./data/stackoverflow_lr/datasets",
+    num_clients: int = 10,
+    samples_per_client: int = 32,
+    num_features: int = LR_FEATURES,
+    num_tags: int = LR_TAGS,
+    seed: int = 0,
+) -> FedDataset:
+    """Multi-label tag prediction: x = normalized bag-of-words
+    [N, num_features], y = multi-hot tags [N, num_tags] (use
+    ``losses.masked_bce_logits``)."""
+    tr = os.path.join(data_dir, "stackoverflow_lr_train.h5")
+    if os.path.exists(tr):
+        import h5py
+
+        with h5py.File(tr, "r") as f:
+            x = np.asarray(f["x"], np.float32)
+            y = np.asarray(f["y"], np.float32)
+            idx = {
+                int(c): np.asarray(v)
+                for c, v in enumerate(np.asarray(f["client_ptr"]))
+            }
+        return FedDataset(
+            train_x=x, train_y=y, test_x=x[:64], test_y=y[:64],
+            train_client_idx=idx, test_client_idx=None,
+            num_classes=num_tags, name="stackoverflow_lr",
+        )
+    rng = np.random.RandomState(seed)
+    n = num_clients * samples_per_client
+    # sparse bags-of-words + tags correlated with the strongest features
+    x = np.zeros((n + 64, num_features), np.float32)
+    y = np.zeros((n + 64, num_tags), np.float32)
+    w = rng.randn(num_features, num_tags).astype(np.float32) * 0.3
+    for i in range(n + 64):
+        nz = rng.randint(3, 12)
+        feats = rng.randint(0, num_features, nz)
+        x[i, feats] = 1.0 / nz
+        logits = x[i] @ w
+        y[i, np.argsort(-logits)[: rng.randint(1, 4)]] = 1.0
+    idx = {
+        c: np.arange(c * samples_per_client, (c + 1) * samples_per_client)
+        for c in range(num_clients)
+    }
+    return FedDataset(
+        train_x=x[:n], train_y=y[:n], test_x=x[n:], test_y=y[n:],
+        train_client_idx=idx, test_client_idx=None,
+        num_classes=num_tags, name="stackoverflow_lr(synthetic-standin)",
+    )

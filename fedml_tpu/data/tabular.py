@@ -1,0 +1,105 @@
+"""Tabular datasets: UCI streams (decentralized online learning),
+lending-club loan and NUS-WIDE (vertical FL).
+
+Reference: ``fedml_api/data_preprocessing/UCI/`` (SUSY, room-occupancy
+CSV streams consumed by ``standalone/decentralized``),
+``lending_club_loan/`` and ``NUS_WIDE/`` (guest/host feature-split
+tables for classical VFL).  Loaders read CSVs when present, otherwise
+emit synthetic stand-ins with the reference's shapes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from fedml_tpu.core.types import FedDataset
+
+
+def _read_csv(path: str, label_col: int = 0, skip_header: int = 0):
+    data = np.genfromtxt(path, delimiter=",", skip_header=skip_header)
+    y = data[:, label_col]
+    x = np.delete(data, label_col, axis=1)
+    return x.astype(np.float32), y.astype(np.int32)
+
+
+def load_uci_stream(
+    name: str = "SUSY",
+    data_dir: str = "./data/UCI",
+    num_clients: int = 8,
+    samples_per_client: int = 64,
+    seed: int = 0,
+) -> FedDataset:
+    """Streaming binary-classification rows for DOL (reference
+    ``standalone/decentralized`` SUSY/room-occupancy).  Row order is
+    preserved — DOL consumes it as a stream and reports regret."""
+    path = os.path.join(data_dir, f"{name}.csv")
+    if os.path.exists(path):
+        x, y = _read_csv(path, label_col=0)
+        y = (y > 0).astype(np.int32)
+    else:
+        rng = np.random.RandomState(seed)
+        dim = 18 if name.upper() == "SUSY" else 5
+        n = num_clients * samples_per_client + 64
+        w = rng.randn(dim).astype(np.float32)
+        x = rng.randn(n, dim).astype(np.float32)
+        y = (x @ w + 0.3 * rng.randn(n) > 0).astype(np.int32)
+        name = f"{name}(synthetic-standin)"
+    n_train = len(x) - 64
+    per = n_train // num_clients
+    idx = {c: np.arange(c * per, (c + 1) * per) for c in range(num_clients)}
+    return FedDataset(
+        train_x=x[:n_train], train_y=y[:n_train],
+        test_x=x[n_train:], test_y=y[n_train:],
+        train_client_idx=idx, test_client_idx=None,
+        num_classes=2, name=f"uci_{name}",
+    )
+
+
+def load_lending_club(
+    data_dir: str = "./data/lending_club_loan",
+    num_hosts: int = 1,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, list]:
+    """VFL table: returns (X, y, feature_splits) where feature_splits
+    gives each party's column slice (guest first) — the reference splits
+    loan features between one guest (with labels) and hosts
+    (``lending_club_loan/lending_club_dataset.py``)."""
+    path = os.path.join(data_dir, "loan_processed.npz")
+    if os.path.exists(path):
+        z = np.load(path)
+        x, y = z["x"].astype(np.float32), z["y"].astype(np.int32)
+    else:
+        rng = np.random.RandomState(seed)
+        n, d = 512, 24
+        w = rng.randn(d).astype(np.float32)
+        x = rng.randn(n, d).astype(np.float32)
+        y = (x @ w > 0).astype(np.int32)
+    d = x.shape[1]
+    parties = num_hosts + 1
+    cuts = np.linspace(0, d, parties + 1).astype(int)
+    splits = [slice(cuts[i], cuts[i + 1]) for i in range(parties)]
+    return x, y, splits
+
+
+def load_nus_wide(
+    data_dir: str = "./data/NUS_WIDE",
+    binary_label: int = 1,
+    seed: int = 0,
+) -> Tuple[np.ndarray, np.ndarray, list]:
+    """NUS-WIDE VFL split: guest = 634-d low-level image features,
+    host = 1000-d tag features (reference ``NUS_WIDE/nus_wide_dataset.py``)."""
+    path = os.path.join(data_dir, "nus_wide_processed.npz")
+    if os.path.exists(path):
+        z = np.load(path)
+        x, y = z["x"].astype(np.float32), z["y"].astype(np.int32)
+        guest_dim = int(z.get("guest_dim", 634))
+    else:
+        rng = np.random.RandomState(seed)
+        n, guest_dim, host_dim = 256, 64, 100
+        x = rng.randn(n, guest_dim + host_dim).astype(np.float32)
+        w = rng.randn(guest_dim + host_dim).astype(np.float32)
+        y = (x @ w > 0).astype(np.int32)
+    return x, y, [slice(0, guest_dim), slice(guest_dim, x.shape[1])]

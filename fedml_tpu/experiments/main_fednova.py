@@ -1,0 +1,8 @@
+"""Entry shim — reference parity with ``fedml_experiments/*/main_fednova.py``."""
+
+import sys
+
+from fedml_tpu.experiments.run import main
+
+if __name__ == "__main__":
+    main(["--algorithm", "fednova", *sys.argv[1:]])

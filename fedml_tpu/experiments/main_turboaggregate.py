@@ -1,0 +1,8 @@
+"""Entry shim — reference parity with ``fedml_experiments/*/main_turboaggregate.py``."""
+
+import sys
+
+from fedml_tpu.experiments.run import main
+
+if __name__ == "__main__":
+    main(["--algorithm", "turboaggregate", *sys.argv[1:]])

@@ -1,0 +1,153 @@
+"""Model + dataset registries for experiment entry points.
+
+Mirrors the reference's switch blocks: ``create_model``
+(``fedml_experiments/distributed/fedavg/main_fedavg.py:217-252``) and
+``load_data`` (``main_fedavg.py:108-214``), with the same model/dataset
+name vocabulary, mapped onto the TPU-native zoo and loaders.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fedml_tpu.core.types import FedDataset
+from fedml_tpu.models.base import ModelBundle
+
+
+def load_data(
+    dataset: str,
+    data_dir: str = "",
+    num_clients: int = 10,
+    partition_method: str = "hetero",
+    partition_alpha: float = 0.5,
+    seed: int = 0,
+) -> FedDataset:
+    d = dict(num_clients=num_clients, seed=seed)
+    if dataset == "mnist":
+        from fedml_tpu.data.mnist import load_mnist
+
+        return load_mnist(data_dir or "./data/mnist", num_clients,
+                          partition="power_law", seed=seed)
+    if dataset in ("cifar10", "cifar100", "cinic10"):
+        from fedml_tpu.data import cifar
+
+        fn = {"cifar10": cifar.load_cifar10, "cifar100": cifar.load_cifar100,
+              "cinic10": cifar.load_cinic10}[dataset]
+        return fn(data_dir or f"./data/{dataset}", num_clients,
+                  partition=partition_method, partition_alpha=partition_alpha,
+                  seed=seed)
+    if dataset == "femnist":
+        from fedml_tpu.data.emnist import load_femnist
+
+        return load_femnist(data_dir or "./data/FederatedEMNIST/datasets",
+                            num_clients, seed=seed)
+    if dataset == "fed_cifar100":
+        from fedml_tpu.data.emnist import load_fed_cifar100
+
+        return load_fed_cifar100(data_dir or "./data/fed_cifar100/datasets",
+                                 seed=seed)
+    if dataset == "shakespeare":
+        from fedml_tpu.data.shakespeare import load_shakespeare
+
+        return load_shakespeare(data_dir or "./data/shakespeare",
+                                num_clients, seed=seed)
+    if dataset == "fed_shakespeare":
+        from fedml_tpu.data.shakespeare import load_fed_shakespeare
+
+        return load_fed_shakespeare(data_dir or "./data/fed_shakespeare/datasets",
+                                    num_clients, seed=seed)
+    if dataset == "stackoverflow_lr":
+        from fedml_tpu.data.stackoverflow import load_stackoverflow_lr
+
+        return load_stackoverflow_lr(data_dir or "./data/stackoverflow_lr",
+                                     num_clients, seed=seed)
+    if dataset == "stackoverflow_nwp":
+        from fedml_tpu.data.stackoverflow import load_stackoverflow_nwp
+
+        return load_stackoverflow_nwp(data_dir or "./data/stackoverflow",
+                                      num_clients, seed=seed)
+    if dataset in ("ILSVRC2012", "imagenet"):
+        from fedml_tpu.data.imagenet import load_imagenet
+
+        return load_imagenet(data_dir or "./data/ImageNet", num_clients,
+                             seed=seed)
+    if dataset in ("gld23k", "gld160k"):
+        from fedml_tpu.data.imagenet import load_landmarks
+
+        return load_landmarks(data_dir or "./data/gld", variant=dataset,
+                              seed=seed)
+    if dataset == "synthetic":
+        from fedml_tpu.data.synthetic import synthetic_classification
+
+        return synthetic_classification(
+            num_clients=num_clients, partition=partition_method,
+            partition_alpha=partition_alpha, seed=seed,
+        )
+    raise ValueError(f"unknown dataset: {dataset}")
+
+
+def create_model(
+    model: str, dataset: str, num_classes: int,
+    image_size: Optional[int] = None,
+    input_shape: Optional[tuple] = None,
+) -> ModelBundle:
+    """The reference's (model, dataset) switch, TPU-native bundles."""
+    img = image_size or (
+        input_shape[0] if input_shape and len(input_shape) >= 2
+        else (28 if dataset in ("mnist", "femnist") else 32)
+    )
+    if model == "lr" and dataset == "mnist":
+        from fedml_tpu.models.linear import logistic_regression
+
+        return logistic_regression(28 * 28, num_classes)
+    if model == "lr" and dataset == "stackoverflow_lr":
+        from fedml_tpu.models.linear import logistic_regression
+
+        return logistic_regression(10000, num_classes)
+    if model == "rnn" and dataset in ("shakespeare", "fed_shakespeare"):
+        from fedml_tpu.models.rnn import rnn_shakespeare
+
+        return rnn_shakespeare(seq_output=(dataset == "fed_shakespeare"))
+    if model == "rnn" and dataset == "stackoverflow_nwp":
+        from fedml_tpu.models.rnn import rnn_stackoverflow
+
+        return rnn_stackoverflow()
+    if model == "cnn":
+        from fedml_tpu.models.cnn import cnn_dropout
+
+        return cnn_dropout(only_digits=False, side=img)
+    if model == "resnet18_gn":
+        from fedml_tpu.models.resnet_gn import resnet18_gn
+
+        return resnet18_gn(num_classes=num_classes, image_size=img)
+    if model in ("resnet56", "resnet110", "resnet20", "resnet32", "resnet44"):
+        from fedml_tpu.models import resnet
+
+        return getattr(resnet, model)(num_classes=num_classes, image_size=img)
+    if model == "mobilenet":
+        from fedml_tpu.models.mobilenet import mobilenet
+
+        return mobilenet(num_classes=num_classes, image_size=img)
+    if model == "mobilenet_v3":
+        from fedml_tpu.models.mobilenet_v3 import mobilenet_v3
+
+        return mobilenet_v3(num_classes=num_classes, model_mode="LARGE",
+                            image_size=img)
+    if model == "efficientnet":
+        from fedml_tpu.models.efficientnet import efficientnet
+
+        return efficientnet("efficientnet-b0", num_classes=num_classes,
+                            image_size=img)
+    if model.startswith("vgg"):
+        from fedml_tpu.models import vgg
+
+        return getattr(vgg, model)(num_classes=num_classes, image_size=img)
+    if model == "lr":
+        # generic fallback: LR flattens any input shape
+        import numpy as np
+
+        from fedml_tpu.models.linear import logistic_regression
+
+        dim = int(np.prod(input_shape)) if input_shape else 784
+        return logistic_regression(dim, num_classes)
+    raise ValueError(f"unknown model: {model} (dataset {dataset})")

@@ -1,0 +1,294 @@
+"""Unified experiment entry point — the L5 layer.
+
+The reference ships one ``main_<algo>.py`` + mpirun shell script per
+algorithm (``fedml_experiments/``, SURVEY.md §1 L5).  Here a single
+typed config + dispatcher covers the whole matrix; per-algorithm
+``main_<algo>.py`` shims (same directory) preserve the familiar entry
+names.  Usage:
+
+    python -m fedml_tpu.experiments.run --algorithm fedavg \
+        --model resnet56 --dataset cifar10 --client_num_in_total 10 \
+        --client_num_per_round 4 --comm_round 10 --epochs 1
+
+Flag names follow the reference's canonical set
+(``main_fedavg.py:46-105``).  ``--ci 1`` shrinks everything for smoke
+runs (reference ``FedAVGAggregator.py:115-120`` semantics).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+import time
+from typing import Optional
+
+from fedml_tpu.core.config import config_to_json, parse_config
+from fedml_tpu.experiments.registry import create_model, load_data
+
+ALGORITHMS = (
+    "fedavg", "fedopt", "fedprox", "fednova", "fedavg_robust",
+    "hierarchical", "decentralized", "fedgkt", "fednas", "centralized",
+    "turboaggregate", "splitnn", "vfl",
+)
+
+
+@dataclasses.dataclass
+class ExperimentConfig:
+    """Canonical experiment flags (reference main_fedavg.py:46-105)."""
+
+    algorithm: str = "fedavg"
+    model: str = "resnet56"
+    dataset: str = "cifar10"
+    data_dir: str = ""
+    partition_method: str = "hetero"
+    partition_alpha: float = 0.5
+    client_num_in_total: int = 10
+    client_num_per_round: int = 4
+    batch_size: int = 64
+    client_optimizer: str = "sgd"
+    lr: float = 0.03
+    momentum: float = 0.0
+    wd: float = 0.001
+    epochs: int = 1
+    comm_round: int = 10
+    frequency_of_the_test: int = 5
+    seed: int = 0
+    ci: int = 0
+    # fedopt
+    server_optimizer: str = "sgd"
+    server_lr: float = 1.0
+    # fedprox
+    mu: float = 0.1
+    # robust
+    defense_type: str = "norm_diff_clipping"
+    norm_bound: float = 5.0
+    stddev: float = 0.025
+    # hierarchical
+    group_num: int = 2
+    group_comm_round: int = 2
+    # fednas
+    stage: str = "search"
+    arch_lr: float = 3e-4
+    lambda_train_regularizer: float = 1.0
+    # fedgkt
+    temperature: float = 3.0
+    alpha_kd: float = 1.0
+    epochs_server: int = 1
+
+
+def _apply_ci(cfg: ExperimentConfig) -> ExperimentConfig:
+    if cfg.ci:
+        return dataclasses.replace(
+            cfg, client_num_in_total=min(cfg.client_num_in_total, 3),
+            client_num_per_round=min(cfg.client_num_per_round, 3),
+            comm_round=min(cfg.comm_round, 2), batch_size=min(cfg.batch_size, 8),
+            dataset="synthetic" if cfg.dataset not in ("mnist", "synthetic")
+            else cfg.dataset,
+            model="lr" if cfg.model not in ("lr", "cnn") else cfg.model,
+        )
+    return cfg
+
+
+def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
+    cfg = _apply_ci(cfg)
+    t0 = time.time()
+
+    if cfg.algorithm == "vfl":  # vertical FL uses its own tabular data
+        from fedml_tpu.algorithms.vfl import VerticalFederation, run_vfl
+        from fedml_tpu.data.tabular import load_lending_club
+        from fedml_tpu.models.finance import vfl_party
+
+        x, y, splits = load_lending_club(cfg.data_dir or "./data/lending_club_loan")
+        n_test = max(32, len(y) // 5)
+        xs = [x[:, s] for s in splits]
+        fed = VerticalFederation(
+            [vfl_party(xi.shape[1], 16) for xi in xs], lr=cfg.lr
+        )
+        _, hist = run_vfl(
+            fed, [xi[:-n_test] for xi in xs], y[:-n_test],
+            [xi[-n_test:] for xi in xs], y[-n_test:],
+            epochs=cfg.comm_round, batch_size=cfg.batch_size,
+        )
+        return {"history": hist, "wall_s": time.time() - t0}
+
+    ds = load_data(cfg.dataset, cfg.data_dir, cfg.client_num_in_total,
+                   cfg.partition_method, cfg.partition_alpha, cfg.seed)
+
+    if cfg.algorithm == "splitnn":
+        from fedml_tpu.algorithms.splitnn import SplitNNSimulation
+        from fedml_tpu.models.cnn import cnn_split_pair
+
+        bottom, top = cnn_split_pair(ds.num_classes, ds.train_x.shape[1:])
+        parts = [
+            (ds.train_x[idx], ds.train_y[idx])
+            for idx in ds.train_client_idx.values()
+        ]
+        sim = SplitNNSimulation(
+            bottom, top, parts, test_data=(ds.test_x, ds.test_y),
+            batch_size=cfg.batch_size, lr=cfg.lr, seed=cfg.seed,
+        )
+        hist = []
+        for _ in range(cfg.comm_round):
+            hist.extend(sim.run_epoch())
+        return {"history": hist, "wall_s": time.time() - t0}
+
+    if cfg.algorithm == "fedgkt":
+        from fedml_tpu.algorithms.fedgkt import FedGKT, FedGKTConfig
+        from fedml_tpu.models.resnet_gkt import resnet8_56, resnet56_server
+
+        img = ds.train_x.shape[1]
+        algo = FedGKT(
+            resnet8_56(ds.num_classes, img), resnet56_server(ds.num_classes, img),
+            ds, FedGKTConfig(
+                num_clients=ds.num_clients, comm_rounds=cfg.comm_round,
+                epochs_client=cfg.epochs, epochs_server=cfg.epochs_server,
+                batch_size=cfg.batch_size, lr_client=cfg.lr, lr_server=cfg.lr,
+                temperature=cfg.temperature, alpha=cfg.alpha_kd, seed=cfg.seed,
+            ))
+        hist = algo.run()
+        return {"history": hist, "wall_s": time.time() - t0}
+
+    if cfg.algorithm == "fednas":
+        from fedml_tpu.algorithms.fedavg import FedAvgConfig
+        from fedml_tpu.algorithms.fednas import (FedNASConfig, FedNASSearch,
+                                                 fednas_train_stage)
+        from fedml_tpu.models.darts.search import darts_search
+
+        img = ds.train_x.shape[1]
+        search = FedNASSearch(
+            darts_search(C=8, num_classes=ds.num_classes, layers=4,
+                         image_size=img),
+            ds, FedNASConfig(
+                num_clients=ds.num_clients, comm_rounds=cfg.comm_round,
+                epochs=cfg.epochs, batch_size=cfg.batch_size, lr=cfg.lr,
+                arch_lr=cfg.arch_lr,
+                lambda_train_regularizer=cfg.lambda_train_regularizer,
+                seed=cfg.seed,
+            ))
+        hist = search.run()
+        genotype = search.genotype()
+        out = {"history": hist, "genotype": str(genotype),
+               "wall_s": time.time() - t0}
+        if cfg.stage == "train":
+            sim = fednas_train_stage(genotype, ds, FedAvgConfig(
+                num_clients=ds.num_clients,
+                clients_per_round=cfg.client_num_per_round,
+                comm_rounds=cfg.comm_round, epochs=cfg.epochs,
+                batch_size=cfg.batch_size, lr=cfg.lr, seed=cfg.seed,
+            ), C=8, layers=4, image_size=img)
+            out["train_history"] = sim.run(log_fn=log_fn)
+        return out
+
+    bundle = create_model(cfg.model, cfg.dataset, ds.num_classes,
+                          input_shape=tuple(ds.train_x.shape[1:]))
+
+    if cfg.algorithm == "centralized":
+        from fedml_tpu.algorithms.centralized import CentralizedTrainer
+
+        trainer = CentralizedTrainer(
+            bundle, ds, batch_size=cfg.batch_size, lr=cfg.lr,
+            optimizer=cfg.client_optimizer, weight_decay=cfg.wd,
+            momentum=cfg.momentum, seed=cfg.seed,
+        )
+        hist = [trainer.train(epochs=cfg.epochs)
+                for _ in range(cfg.comm_round)]
+        hist[-1].update(trainer.evaluate())
+        return {"history": hist, "final": hist[-1],
+                "wall_s": time.time() - t0}
+
+    if cfg.algorithm == "decentralized":
+        from fedml_tpu.algorithms.decentralized import DecentralizedSimulation
+        from fedml_tpu.core.topology import SymmetricTopologyManager
+
+        tm = SymmetricTopologyManager(
+            ds.num_clients, neighbor_num=min(2, ds.num_clients - 1),
+            seed=cfg.seed,
+        )
+        sim = DecentralizedSimulation(
+            bundle, ds, tm.generate_topology(), epochs=cfg.epochs,
+            batch_size=cfg.batch_size, lr=cfg.lr, seed=cfg.seed,
+        )
+        hist = sim.run(cfg.comm_round)
+        final = sim.evaluate_worker(0)
+        return {"history": hist, "final": final, "wall_s": time.time() - t0}
+
+    if cfg.algorithm == "turboaggregate":
+        from fedml_tpu.algorithms.turboaggregate import (
+            TurboAggregateConfig, TurboAggregateSimulation)
+
+        algo = TurboAggregateSimulation(bundle, ds, TurboAggregateConfig(
+            num_clients=ds.num_clients, comm_rounds=cfg.comm_round,
+            epochs=cfg.epochs, batch_size=cfg.batch_size, lr=cfg.lr,
+            seed=cfg.seed,
+        ))
+        hist = algo.run()
+        return {"history": hist, "wall_s": time.time() - t0}
+
+    # the FedAvg-engine family
+    from fedml_tpu.algorithms import fedavg as fa
+
+    common = dict(
+        num_clients=ds.num_clients,
+        clients_per_round=cfg.client_num_per_round,
+        comm_rounds=cfg.comm_round, epochs=cfg.epochs,
+        batch_size=cfg.batch_size, client_optimizer=cfg.client_optimizer,
+        lr=cfg.lr, momentum=cfg.momentum, weight_decay=cfg.wd,
+        frequency_of_the_test=cfg.frequency_of_the_test, seed=cfg.seed,
+    )
+    if cfg.algorithm == "fedavg":
+        sim = fa.FedAvgSimulation(bundle, ds, fa.FedAvgConfig(**common))
+    elif cfg.algorithm == "fedprox":
+        from fedml_tpu.algorithms.fedprox import FedProxSimulation
+
+        sim = FedProxSimulation(bundle, ds, fa.FedAvgConfig(**common),
+                                mu=cfg.mu)
+    elif cfg.algorithm == "fedopt":
+        from fedml_tpu.algorithms.fedopt import FedOptSimulation
+
+        sim = FedOptSimulation(
+            bundle, ds, fa.FedAvgConfig(**common),
+            server_optimizer=cfg.server_optimizer, server_lr=cfg.server_lr,
+        )
+    elif cfg.algorithm == "fednova":
+        nova_cfg = fa.FedAvgConfig(**{**common, "weight_decay": 0.0})
+        from fedml_tpu.algorithms.fednova import FedNovaSimulation
+
+        sim = FedNovaSimulation(bundle, ds, nova_cfg)
+    elif cfg.algorithm == "fedavg_robust":
+        from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustSimulation
+
+        sim = FedAvgRobustSimulation(
+            bundle, ds, fa.FedAvgConfig(**common),
+            defense_type=cfg.defense_type, norm_bound=cfg.norm_bound,
+            stddev=cfg.stddev,
+        )
+    elif cfg.algorithm == "hierarchical":
+        from fedml_tpu.algorithms.hierarchical import HierarchicalSimulation
+
+        sim = HierarchicalSimulation(
+            bundle, ds, fa.FedAvgConfig(**common),
+            num_groups=cfg.group_num, group_comm_round=cfg.group_comm_round,
+        )
+    else:
+        raise ValueError(f"unknown algorithm: {cfg.algorithm}")
+
+    hist = sim.run(log_fn=log_fn)
+    final = {**hist[-1], **sim.evaluate_global()}
+    return {"history": hist, "final": final, "wall_s": time.time() - t0}
+
+
+def main(argv=None):
+    cfg = parse_config(ExperimentConfig, argv)
+    if cfg.algorithm not in ALGORITHMS:
+        raise SystemExit(f"--algorithm must be one of {ALGORITHMS}")
+    print(config_to_json(cfg))
+    out = run_experiment(cfg)
+    tail = out.get("final") or (out["history"][-1] if out.get("history") else {})
+    print(json.dumps({"final": tail, "wall_s": round(out["wall_s"], 2)},
+                     default=str))
+    return out
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -70,3 +70,39 @@ def cnn_dropout(only_digits: bool = False, side: int = 28) -> ModelBundle:
         input_shape=(side, side, 1),
         needs_dropout_rng=True,
     )
+
+
+class _CNNBottom(nn.Module):
+    """Conv half of the McMahan CNN — the SplitNN client side
+    (reference splits at the flatten boundary, ``split_nn/client.py``)."""
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if x.ndim == 2:
+            side = int(x.shape[-1] ** 0.5)
+            x = x.reshape((x.shape[0], side, side, -1))
+        x = nn.relu(nn.Conv(32, (5, 5), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(nn.Conv(64, (5, 5), padding="SAME")(x))
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        return x.reshape((x.shape[0], -1))
+
+
+class _CNNTop(nn.Module):
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.relu(nn.Dense(512)(x))
+        return nn.Dense(self.num_classes)(x)
+
+
+def cnn_split_pair(num_classes: int, input_shape) -> tuple:
+    """(bottom, top) ModelBundles for SplitNN over image data."""
+    side = input_shape[0] if len(input_shape) >= 2 else int(input_shape[0] ** 0.5)
+    feat = (side // 4) * (side // 4) * 64
+    return (
+        ModelBundle(module=_CNNBottom(), input_shape=tuple(input_shape)),
+        ModelBundle(module=_CNNTop(num_classes=num_classes),
+                    input_shape=(feat,)),
+    )

@@ -1,0 +1,89 @@
+"""Fixed (discrete) DARTS network built from a Genotype — the FedNAS
+"train" stage model.
+
+Parity with the reference ``fedml_api/model/cv/darts/model.py``:
+compiled Cell from (op, index) pairs with stride-2 ops on inputs 0/1 of
+reduction cells (``model.py:8-60``), NetworkCIFAR with 3C stem and
+reductions at layers//3, 2·layers//3 (``model.py:111-160``).
+Drop-path and the auxiliary head are omitted (the reference's FedNAS
+path runs with ``auxiliary=False`` and drop_path only at inference-time
+default 0.5 never exercised federated); affine BN as in the reference's
+fixed cells.
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+from fedml_tpu.models.darts.genotypes import Genotype
+from fedml_tpu.models.darts.ops import OPS, FactorizedReduce, ReLUConvBN
+
+
+class FixedCell(nn.Module):
+    genotype: Genotype
+    C: int
+    reduction: bool
+    reduction_prev: bool
+
+    @nn.compact
+    def __call__(self, s0, s1, train: bool = False):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.C)(s0, train)
+        else:
+            s0 = ReLUConvBN(self.C, 1, 1)(s0, train)
+        s1 = ReLUConvBN(self.C, 1, 1)(s1, train)
+
+        gene = self.genotype.reduce if self.reduction else self.genotype.normal
+        concat = (self.genotype.reduce_concat if self.reduction
+                  else self.genotype.normal_concat)
+        states = [s0, s1]
+        for i in range(len(gene) // 2):
+            (n1, i1), (n2, i2) = gene[2 * i], gene[2 * i + 1]
+            h1 = OPS[n1](self.C, 2 if self.reduction and i1 < 2 else 1, True)(
+                states[i1], train
+            )
+            h2 = OPS[n2](self.C, 2 if self.reduction and i2 < 2 else 1, True)(
+                states[i2], train
+            )
+            states.append(h1 + h2)
+        return jnp.concatenate([states[i] for i in concat], axis=-1)
+
+
+class NetworkCIFAR(nn.Module):
+    genotype: Genotype
+    C: int = 36
+    num_classes: int = 10
+    layers: int = 20
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        c_curr = 3 * self.C
+        s0 = s1 = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5
+        )(nn.Conv(c_curr, (3, 3), padding=1, use_bias=False)(x))
+        c_curr = self.C
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                c_curr *= 2
+            s0, s1 = s1, FixedCell(
+                genotype=self.genotype, C=c_curr, reduction=reduction,
+                reduction_prev=reduction_prev,
+            )(s0, s1, train)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(out)
+
+
+def darts_network(genotype: Genotype, C=36, num_classes=10, layers=20,
+                  image_size=32) -> ModelBundle:
+    """Reference factory ``NetworkCIFAR(C, num_classes, layers, auxiliary,
+    genotype)`` (``model.py:111-160``)."""
+    return ModelBundle(
+        module=NetworkCIFAR(genotype=genotype, C=C, num_classes=num_classes,
+                            layers=layers),
+        input_shape=(image_size, image_size, 3),
+    )

@@ -1,0 +1,174 @@
+"""DARTS search network (continuous relaxation) in flax.
+
+Parity with the reference ``fedml_api/model/cv/darts/model_search.py``:
+MixedOp (``:10-24``), Cell with 4 intermediate nodes / 14 edges and
+stride-2 mixed ops on the first two inputs of reduction cells
+(``:26-60``), Network = 3C stem, reduction at layers//3 and 2·layers//3,
+global pool + linear head (``:172-231``).
+
+TPU-first departure: architecture parameters (alphas) are NOT hidden
+module state — they are explicit inputs to ``__call__``.  The functional
+split lets FedNAS treat (weights, alphas) as two pytrees with different
+optimizers and different aggregation, without the reference's id()-based
+parameter filtering (``FedNASTrainer.py:38-44``).  All 8 op branches of
+a MixedOp evaluate and get weight-summed — a static, dense compute
+pattern XLA fuses well on the MXU (the sparse alternative would be
+data-dependent control flow, which doesn't jit).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.darts.ops import OPS, PRIMITIVES, FactorizedReduce, ReLUConvBN
+
+PyTree = Any
+
+
+def num_edges(steps: int = 4) -> int:
+    return sum(2 + i for i in range(steps))
+
+
+class MixedOp(nn.Module):
+    C: int
+    stride: int
+
+    @nn.compact
+    def __call__(self, x, weights, train: bool = False):
+        # weights: [n_ops]; dense weighted sum of all branches.  Pooling
+        # primitives get the reference's affine-free BatchNorm wrapper
+        # (model_search.py:14-19) so their magnitudes are commensurate
+        # with the conv branches in the alpha-weighted sum.
+        outs = []
+        for name in PRIMITIVES:
+            o = OPS[name](self.C, self.stride, False)(x, train)
+            if "pool" in name:
+                o = nn.BatchNorm(
+                    use_running_average=not train, momentum=0.9,
+                    epsilon=1e-5, use_scale=False, use_bias=False,
+                )(o)
+            outs.append(o)
+        return sum(w * o for w, o in zip(weights, outs))
+
+
+class SearchCell(nn.Module):
+    steps: int
+    multiplier: int
+    C: int
+    reduction: bool
+    reduction_prev: bool
+
+    @nn.compact
+    def __call__(self, s0, s1, weights, train: bool = False):
+        if self.reduction_prev:
+            s0 = FactorizedReduce(self.C, affine=False)(s0, train)
+        else:
+            s0 = ReLUConvBN(self.C, 1, 1, affine=False)(s0, train)
+        s1 = ReLUConvBN(self.C, 1, 1, affine=False)(s1, train)
+
+        states = [s0, s1]
+        offset = 0
+        for i in range(self.steps):
+            s = sum(
+                MixedOp(self.C, 2 if self.reduction and j < 2 else 1)(
+                    h, weights[offset + j], train
+                )
+                for j, h in enumerate(states)
+            )
+            offset += len(states)
+            states.append(s)
+        return jnp.concatenate(states[-self.multiplier:], axis=-1)
+
+
+class SearchNetwork(nn.Module):
+    C: int = 16
+    num_classes: int = 10
+    layers: int = 8
+    steps: int = 4
+    multiplier: int = 4
+    stem_multiplier: int = 3
+
+    @nn.compact
+    def __call__(self, x, alphas_normal, alphas_reduce, train: bool = False):
+        wn = jax.nn.softmax(alphas_normal, axis=-1)
+        wr = jax.nn.softmax(alphas_reduce, axis=-1)
+        c_curr = self.stem_multiplier * self.C
+        s0 = s1 = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-5
+        )(nn.Conv(c_curr, (3, 3), padding=1, use_bias=False)(x))
+
+        c_curr = self.C
+        reduction_prev = False
+        for i in range(self.layers):
+            reduction = i in (self.layers // 3, 2 * self.layers // 3)
+            if reduction:
+                c_curr *= 2
+            s0, s1 = s1, SearchCell(
+                steps=self.steps, multiplier=self.multiplier, C=c_curr,
+                reduction=reduction, reduction_prev=reduction_prev,
+            )(s0, s1, wr if reduction else wn, train)
+            reduction_prev = reduction
+        out = jnp.mean(s1, axis=(1, 2))
+        return nn.Dense(self.num_classes)(out)
+
+
+@dataclasses.dataclass
+class SearchBundle:
+    """Functional wrapper: (weights pytree, alphas pytree) kept separate."""
+
+    module: SearchNetwork
+    input_shape: Sequence[int]
+    input_dtype: Any = jnp.float32
+
+    def init_alphas(self, rng: jax.Array) -> PyTree:
+        """1e-3·N(0,1) init, reference ``model_search.py:232-241``."""
+        n = num_edges(self.module.steps)
+        k = len(PRIMITIVES)
+        ka, kb = jax.random.split(rng)
+        return {
+            "alphas_normal": 1e-3 * jax.random.normal(ka, (n, k)),
+            "alphas_reduce": 1e-3 * jax.random.normal(kb, (n, k)),
+        }
+
+    def init(self, rng: jax.Array) -> PyTree:
+        dummy = jnp.zeros((1, *self.input_shape), self.input_dtype)
+        alphas = self.init_alphas(jax.random.fold_in(rng, 1))
+        return self.module.init(
+            {"params": rng}, dummy, alphas["alphas_normal"],
+            alphas["alphas_reduce"], train=False,
+        )
+
+    def apply_train(self, variables, alphas, x):
+        if "batch_stats" in variables:
+            logits, mutated = self.module.apply(
+                variables, x, alphas["alphas_normal"], alphas["alphas_reduce"],
+                train=True, mutable=["batch_stats"],
+            )
+            return logits, {**variables, "batch_stats": mutated["batch_stats"]}
+        logits = self.module.apply(
+            variables, x, alphas["alphas_normal"], alphas["alphas_reduce"],
+            train=True,
+        )
+        return logits, variables
+
+    def apply_eval(self, variables, alphas, x):
+        return self.module.apply(
+            variables, x, alphas["alphas_normal"], alphas["alphas_reduce"],
+            train=False,
+        )
+
+
+def darts_search(C=16, num_classes=10, layers=8, image_size=32,
+                 steps=4, multiplier=4) -> SearchBundle:
+    """Reference factory ``Network(C, num_classes, layers, ...)``
+    (``model_search.py:174``)."""
+    return SearchBundle(
+        module=SearchNetwork(C=C, num_classes=num_classes, layers=layers,
+                             steps=steps, multiplier=multiplier),
+        input_shape=(image_size, image_size, 3),
+    )

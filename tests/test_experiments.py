@@ -1,0 +1,69 @@
+"""L5 experiments layer: typed config CLI + the unified runner
+(smoke tests in the reference's CI style — tiny end-to-end runs,
+``CI-script-*.sh`` semantics, SURVEY.md §4.2)."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.config import config_to_json, parse_config
+from fedml_tpu.experiments.registry import create_model, load_data
+from fedml_tpu.experiments.run import ExperimentConfig, run_experiment
+
+
+def test_parse_config_overrides_and_serializes():
+    cfg = parse_config(ExperimentConfig, [
+        "--algorithm", "fedprox", "--lr", "0.5", "--mu", "0.01",
+        "--comm_round", "3",
+    ])
+    assert cfg.algorithm == "fedprox" and cfg.lr == 0.5
+    assert cfg.mu == 0.01 and cfg.comm_round == 3
+    rec = json.loads(config_to_json(cfg))
+    assert rec["mu"] == 0.01
+
+
+def test_registry_model_dataset_pairs():
+    ds = load_data("synthetic", num_clients=3)
+    b = create_model("lr", "mnist", 10)
+    assert b.input_shape == (784,)
+    b2 = create_model("rnn", "fed_shakespeare", 90)
+    assert b2.input_dtype.__name__ == "int32"
+    with pytest.raises(ValueError):
+        create_model("nope", "mnist", 10)
+    with pytest.raises(ValueError):
+        load_data("nope")
+
+
+def _ci_cfg(**kw):
+    return dataclasses.replace(
+        ExperimentConfig(dataset="synthetic", model="lr",
+                         client_num_in_total=3, client_num_per_round=3,
+                         comm_round=2, batch_size=8, epochs=1,
+                         frequency_of_the_test=1, lr=0.1),
+        **kw,
+    )
+
+
+@pytest.mark.parametrize("algo", ["fedavg", "fedprox", "fedopt", "fednova"])
+def test_run_experiment_fedavg_family(algo):
+    out = run_experiment(_ci_cfg(algorithm=algo), log_fn=None)
+    assert np.isfinite(out["final"]["test_acc"])
+    assert len(out["history"]) == 2
+
+
+def test_run_experiment_centralized_and_decentralized():
+    out = run_experiment(_ci_cfg(algorithm="centralized"), log_fn=None)
+    assert "test_acc" in out["final"]
+    out2 = run_experiment(_ci_cfg(algorithm="decentralized"), log_fn=None)
+    assert "test_acc" in out2["final"]
+
+
+def test_run_experiment_hierarchical_and_vfl():
+    out = run_experiment(_ci_cfg(algorithm="hierarchical", group_num=2,
+                                 group_comm_round=1), log_fn=None)
+    assert np.isfinite(out["final"]["test_acc"])
+    out2 = run_experiment(_ci_cfg(algorithm="vfl", comm_round=2,
+                                  batch_size=64), log_fn=None)
+    assert "auc" in out2["history"][-1] or "acc" in out2["history"][-1]

@@ -1,0 +1,110 @@
+"""Transformer LM with pluggable attention — the long-context model
+family.
+
+The reference's NLP ceiling is a 2-layer LSTM over 80-char windows
+(``model/nlp/rnn.py``, SURVEY.md §5.7).  This decoder-only transformer
+is the rebuild's long-context extension: its attention is an injected
+function, so the SAME module runs
+
+- single-device exact blockwise attention (O(L) memory), or
+- ring attention over a sequence-sharded mesh axis
+  (``parallel.ring_attention.ring_attention`` under ``shard_map``),
+
+with no model code changes.  Pre-LN blocks, learned positional
+embeddings, weight-tied output head.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.models.base import ModelBundle
+from fedml_tpu.parallel.ring_attention import blockwise_attention
+
+# (q, k, v, causal) over [L, H, D] per example
+AttnFn = Callable
+
+
+def _default_attn(q, k, v, causal):
+    return blockwise_attention(q, k, v, causal=causal, block_size=512)
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    attn_fn: Optional[AttnFn] = None
+    causal: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        B, L, E = x.shape
+        H = self.num_heads
+        D = E // H
+        qkv = nn.Dense(3 * E, use_bias=False)(x)
+        q, k, v = jnp.split(qkv.reshape(B, L, 3 * H, D), 3, axis=2)
+        attn = self.attn_fn or _default_attn
+        out = jax.vmap(lambda a, b, c: attn(a, b, c, self.causal))(q, k, v)
+        return nn.Dense(E, use_bias=False)(out.reshape(B, L, E))
+
+
+class Block(nn.Module):
+    num_heads: int
+    mlp_ratio: int = 4
+    attn_fn: Optional[AttnFn] = None
+
+    @nn.compact
+    def __call__(self, x):
+        E = x.shape[-1]
+        x = x + MultiHeadAttention(self.num_heads, self.attn_fn)(
+            nn.LayerNorm()(x)
+        )
+        h = nn.LayerNorm()(x)
+        h = nn.Dense(self.mlp_ratio * E)(h)
+        h = nn.gelu(h)
+        return x + nn.Dense(E)(h)
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 256
+    embed_dim: int = 128
+    num_heads: int = 4
+    num_layers: int = 2
+    max_len: int = 2048
+    attn_fn: Optional[AttnFn] = None
+    pos_offset_fn: Optional[Callable] = None  # (local_len) -> global offset
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, L = x.shape
+        tok = nn.Embed(self.vocab_size, self.embed_dim, name="wte")
+        h = tok(x.astype(jnp.int32))
+        pos0 = self.pos_offset_fn(L) if self.pos_offset_fn else 0
+        if isinstance(pos0, int) and pos0 + L > self.max_len:
+            raise ValueError(
+                f"sequence length {L} exceeds max_len {self.max_len}"
+            )
+        wpe = nn.Embed(self.max_len, self.embed_dim, name="wpe")
+        h = h + wpe(pos0 + jnp.arange(L))[None]
+        for _ in range(self.num_layers):
+            h = Block(self.num_heads, attn_fn=self.attn_fn)(h)
+        h = nn.LayerNorm()(h)
+        # weight-tied head
+        return tok.attend(h)
+
+
+def transformer_lm(
+    vocab_size=256, embed_dim=128, num_heads=4, num_layers=2, seq_len=256,
+    attn_fn: Optional[AttnFn] = None,
+) -> ModelBundle:
+    return ModelBundle(
+        module=TransformerLM(
+            vocab_size=vocab_size, embed_dim=embed_dim, num_heads=num_heads,
+            num_layers=num_layers, max_len=max(seq_len, 2048),
+            attn_fn=attn_fn,
+        ),
+        input_shape=(seq_len,),
+        input_dtype=jnp.int32,
+    )

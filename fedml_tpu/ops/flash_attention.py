@@ -1,0 +1,166 @@
+"""Pallas TPU flash-attention kernel.
+
+The hot op of the transformer family (``models/transformer.py``):
+softmax(QKᵀ/√d)V computed blockwise in VMEM with online-softmax
+accumulation — no [L, L] score matrix ever hits HBM.  The kernel is the
+per-device inner loop; ring attention (``parallel/ring_attention.py``)
+composes it across devices.
+
+Layout per pallas core: one (batch·head) slice [L, D]; the caller vmaps
+over batch and heads.  Grid = (q_blocks, kv_blocks) with the kv axis
+iterated innermost ("arbitrary" semantics) so the VMEM scratch (m, l,
+acc) carries across kv steps of one q block — the standard TPU flash
+pattern from the pallas guide (grid/scratch/`pl.when` sections).
+
+``flash_attention(..., interpret=True)`` runs the same kernel on CPU
+(tests); ``blockwise_attention`` remains the lax fallback.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from jax.experimental import pallas as pl
+
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
+                  *, sm_scale: float, causal: bool, block_q: int,
+                  block_k: int):
+    qi = pl.program_id(0)
+    ki = pl.program_id(1)
+    nk = pl.num_programs(1)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # causal: a KV block strictly above the diagonal contributes nothing;
+    # skip its matmuls entirely (half the work for long sequences)
+    visible = (ki * block_k <= qi * block_q + block_q - 1) if causal else True
+
+    @pl.when(visible)
+    def _compute():
+        q = q_ref[:]            # [BQ, D]
+        k = k_ref[:]            # [BK, D]
+        v = v_ref[:]            # [BK, D]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * sm_scale            # [BQ, BK]
+
+        if causal:
+            qpos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 0
+            )
+            kpos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, s.shape, 1
+            )
+            s = jnp.where(kpos <= qpos, s, NEG_INF)
+
+        m_prev = m_scr[:, :1]                       # [BQ, 1]
+        l_prev = l_scr[:, :1]
+        m_cur = s.max(axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                      # [BQ, BK]
+        alpha = jnp.exp(m_prev - m_new)             # [BQ, 1]
+        l_new = l_prev * alpha + p.sum(axis=1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jax.lax.dot_general(
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ki == nk - 1)
+    def _finalize():
+        o_ref[:] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)).astype(
+            o_ref.dtype
+        )
+
+
+def _flash_single(q, k, v, *, causal, block_q, block_k, interpret):
+    """Flash attention for one [L, D] head slice."""
+    Lq, D = q.shape
+    Lk = k.shape[0]
+    block_q = min(block_q, Lq)
+    block_k = min(block_k, Lk)
+    if Lq % block_q or Lk % block_k:
+        raise ValueError(
+            f"sequence ({Lq},{Lk}) must divide blocks ({block_q},{block_k})"
+        )
+    grid = (Lq // block_q, Lk // block_k)
+    sm_scale = 1.0 / (D ** 0.5)
+
+    scratch_shapes = [
+        pltpu.VMEM((block_q, 128), jnp.float32),   # running max m
+        pltpu.VMEM((block_q, 128), jnp.float32),   # running sum l
+        pltpu.VMEM((block_q, D), jnp.float32),     # output accumulator
+    ]
+
+    kernel = functools.partial(
+        _flash_kernel, sm_scale=sm_scale, causal=causal,
+        block_q=block_q, block_k=block_k,
+    )
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((block_q, D), lambda qi, ki: (qi, 0)),
+            pl.BlockSpec((block_k, D), lambda qi, ki: (ki, 0)),
+            pl.BlockSpec((block_k, D), lambda qi, ki: (ki, 0)),
+        ],
+        out_specs=pl.BlockSpec((block_q, D), lambda qi, ki: (qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((Lq, D), q.dtype),
+        scratch_shapes=scratch_shapes,
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v)
+
+
+def flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *,
+    causal: bool = False,
+    block_q: int = 128,
+    block_k: int = 128,
+    interpret: bool = False,
+) -> jax.Array:
+    """Flash attention over [L, H, D] (no batch; vmap for batches).
+
+    Drop-in for ``parallel.ring_attention.blockwise_attention`` where
+    shapes divide the block sizes.
+    """
+    run = functools.partial(
+        _flash_single, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    # vmap over a LEADING head axis: pallas prepends the batch dim to the
+    # grid, keeping each block's trailing dims tile-aligned ([L, D])
+    qh, kh, vh = (t.swapaxes(0, 1) for t in (q, k, v))
+    out = jax.vmap(run)(qh, kh, vh)
+    return out.swapaxes(0, 1)
+
+
+def flash_attn_fn(block_q: int = 128, block_k: int = 128,
+                  interpret: bool = False):
+    """Adapter matching the TransformerLM ``attn_fn`` signature."""
+
+    def attn(q, k, v, causal):
+        return flash_attention(q, k, v, causal=causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret)
+
+    return attn

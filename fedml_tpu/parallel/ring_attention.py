@@ -1,0 +1,181 @@
+"""Ring attention — sequence/context parallelism over a device mesh.
+
+The reference has no attention at all (SURVEY.md §5.7: 2-layer LSTMs,
+80-char windows); this module is the TPU-native long-context substrate
+the rebuild adds so the mesh design scales past it.  Design follows the
+public ring-attention recipe (Liu et al. 2023, blockwise online-softmax
+attention with K/V blocks rotating around the ICI ring):
+
+- ``blockwise_attention``: single-device chunked attention with online
+  softmax — O(seq) memory, exact (not approximate).
+- ``ring_attention``: inside ``shard_map`` over a sequence-sharded axis,
+  each device holds one Q/K/V shard; after attending its local block,
+  K/V shards rotate via ``lax.ppermute`` (ICI neighbor exchange) for
+  ``axis_size - 1`` steps while local attention accumulates (m, l, o)
+  online-softmax state.  Compute overlaps communication since each
+  step's matmuls and the permute are independent XLA ops the scheduler
+  pipelines.
+- causal masking uses GLOBAL positions (shard offset = axis index), so
+  the sharded result equals dense causal attention up to float addition
+  order.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, bias):
+    """One (q-block, kv-block) attention contribution.
+
+    q [Lq, H, D], k/v [Lk, H, D], bias [Lq, Lk] additive (0 / -inf mask).
+    Returns (m [Lq,H], l [Lq,H], o [Lq,H,D]) online-softmax partials.
+    """
+    d = q.shape[-1]
+    s = jnp.einsum("qhd,khd->hqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    s = s + bias[None, :, :]
+    m = s.max(axis=-1)                      # [H, Lq]
+    p = jnp.exp(s - m[..., None])           # [H, Lq, Lk]
+    l = p.sum(axis=-1)                      # [H, Lq]
+    o = jnp.einsum("hqk,khd->qhd", p, v)    # [Lq, H, D]
+    return m.swapaxes(0, 1), l.swapaxes(0, 1), o
+
+
+def _merge(m1, l1, o1, m2, l2, o2):
+    """Merge two online-softmax partial states."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    l = l1 * a1 + l2 * a2
+    o = o1 * a1[..., None] + o2 * a2[..., None]
+    return m, l, o
+
+
+def _partial_attention(q, k, v, *, causal, block_size, q_offset, kv_offset):
+    """(m, l, o) partials of Q [Lq,H,D] against K/V [Lk,H,D], scanned in
+    KV blocks.  Pads ragged K/V to a block multiple and masks the pad —
+    the ONE shared inner loop for both the single-device and ring paths.
+
+    ``q_offset``/``kv_offset`` are GLOBAL positions of the first
+    query/key; kv_offset may be a traced value (ring path).
+    """
+    Lq, H, D = q.shape
+    Lk = k.shape[0]
+    bs = min(block_size, Lk)
+    n_blocks = (Lk + bs - 1) // bs
+    pad = n_blocks * bs - Lk
+    if pad:
+        k = jnp.pad(k, ((0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, pad), (0, 0), (0, 0)))
+
+    qpos = q_offset + jnp.arange(Lq)
+
+    def body(carry, i):
+        m, l, o = carry
+        kb = lax.dynamic_slice_in_dim(k, i * bs, bs)
+        vb = lax.dynamic_slice_in_dim(v, i * bs, bs)
+        # local (unshifted) key index for pad masking; global for causal
+        local_kpos = i * bs + jnp.arange(bs)
+        bias = jnp.where(local_kpos[None, :] < Lk, 0.0, NEG_INF)
+        if causal:
+            kpos = kv_offset + local_kpos
+            bias = bias + jnp.where(
+                kpos[None, :] <= qpos[:, None], 0.0, NEG_INF
+            )
+        else:
+            bias = jnp.broadcast_to(bias, (Lq, bs))
+        mb, lb, ob = _block_attn(q, kb, vb, bias.astype(q.dtype))
+        return _merge(m, l, o, mb, lb, ob), None
+
+    m0 = jnp.full((Lq, H), NEG_INF, q.dtype)
+    l0 = jnp.zeros((Lq, H), q.dtype)
+    o0 = jnp.zeros_like(q)
+    (m, l, o), _ = lax.scan(body, (m0, l0, o0), jnp.arange(n_blocks))
+    return m, l, o
+
+
+def _normalize(m, l, o):
+    del m
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
+def blockwise_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    *,
+    causal: bool = False,
+    block_size: int = 512,
+    q_offset: int = 0,
+    kv_offset: int = 0,
+) -> jax.Array:
+    """Exact attention over [L, H, D] tensors in KV blocks (O(L) memory).
+
+    ``q_offset``/``kv_offset`` are the global positions of the first
+    query/key — how ring shards express causal masks.
+    """
+    return _normalize(*_partial_attention(
+        q, k, v, causal=causal, block_size=block_size,
+        q_offset=q_offset, kv_offset=kv_offset,
+    ))
+
+
+def ring_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    block_size: int = 512,
+) -> jax.Array:
+    """Sequence-parallel exact attention INSIDE shard_map.
+
+    Each device holds the local shard [L_local, H, D] of a sequence
+    sharded over ``axis_name``.  The local K/V block is attended first;
+    then K/V rotate left around the ring for ``axis_size - 1`` steps so
+    every query attends every key with no wasted final exchange.
+    Returns the local output shard.
+    """
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    L = q.shape[0]
+    q_offset = my_idx * L
+
+    perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+
+    # step 0: the resident (local) K/V shard
+    state = _partial_attention(
+        q, k, v, causal=causal, block_size=block_size,
+        q_offset=q_offset, kv_offset=my_idx * L,
+    )
+
+    def step(carry, i):
+        m, l, o, kc, vc = carry
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        # after i rotations the resident shard started at device my+i
+        src = (my_idx + i) % axis_size
+        mb, lb, ob = _partial_attention(
+            q, kc, vc, causal=causal, block_size=block_size,
+            q_offset=q_offset, kv_offset=src * L,
+        )
+        m, l, o = _merge(m, l, o, mb, lb, ob)
+        return (m, l, o, kc, vc), None
+
+    (m, l, o, _, _), _ = lax.scan(
+        step, (*state, k, v), jnp.arange(1, axis_size)
+    )
+    return _normalize(m, l, o)
+
+
+def dense_attention(q, k, v, *, causal: bool = False) -> jax.Array:
+    """Reference implementation for tests: plain softmax(QKᵀ)V, [L, H, D]."""
+    d = q.shape[-1]
+    s = jnp.einsum("qhd,khd->hqk", q, k) / jnp.sqrt(d).astype(q.dtype)
+    if causal:
+        L, Lk = q.shape[0], k.shape[0]
+        mask = jnp.tril(jnp.ones((L, Lk), bool))
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("hqk,khd->qhd", p, v)

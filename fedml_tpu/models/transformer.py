@@ -97,12 +97,12 @@ class TransformerLM(nn.Module):
 
 def transformer_lm(
     vocab_size=256, embed_dim=128, num_heads=4, num_layers=2, seq_len=256,
-    attn_fn: Optional[AttnFn] = None,
+    attn_fn: Optional[AttnFn] = None, max_len: Optional[int] = None,
 ) -> ModelBundle:
     return ModelBundle(
         module=TransformerLM(
             vocab_size=vocab_size, embed_dim=embed_dim, num_heads=num_heads,
-            num_layers=num_layers, max_len=max(seq_len, 2048),
+            num_layers=num_layers, max_len=max_len or seq_len,
             attn_fn=attn_fn,
         ),
         input_shape=(seq_len,),

@@ -2,9 +2,11 @@
 
 The hot op of the transformer family (``models/transformer.py``):
 softmax(QKᵀ/√d)V computed blockwise in VMEM with online-softmax
-accumulation — no [L, L] score matrix ever hits HBM.  The kernel is the
-per-device inner loop; ring attention (``parallel/ring_attention.py``)
-composes it across devices.
+accumulation — no [L, L] score matrix ever hits HBM.  This is the
+single-device attention path; the ring path
+(``parallel/ring_attention.py``) keeps its own lax blockwise inner loop
+because merging shards needs raw (m, l, o) online-softmax partials and
+global position offsets, which this kernel does not expose.
 
 Layout per pallas core: one (batch·head) slice [L, D]; the caller vmaps
 over batch and heads.  Grid = (q_blocks, kv_blocks) with the kv axis

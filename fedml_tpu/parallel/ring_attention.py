@@ -91,8 +91,12 @@ def _partial_attention(q, k, v, *, causal, block_size, q_offset, kv_offset):
         mb, lb, ob = _block_attn(q, kb, vb, bias.astype(q.dtype))
         return _merge(m, l, o, mb, lb, ob), None
 
-    m0 = jnp.full((Lq, H), NEG_INF, q.dtype)
-    l0 = jnp.zeros((Lq, H), q.dtype)
+    # derive carry inits from q so they inherit q's varying-manual-axes
+    # type under shard_map (JAX ≥0.9 typed vma; a fresh jnp.full would
+    # be unvarying and fail lax.scan's carry typecheck on the ring path)
+    zero = jnp.zeros_like(q[:, :, 0])       # [Lq, H]
+    m0 = zero + jnp.asarray(NEG_INF, q.dtype)
+    l0 = zero
     o0 = jnp.zeros_like(q)
     (m, l, o), _ = lax.scan(body, (m0, l0, o0), jnp.arange(n_blocks))
     return m, l, o

@@ -78,7 +78,6 @@ def sequence_parallel_lm(
         _local_forward, mesh=mesh,
         in_specs=(P(), P(None, axis)),
         out_specs=P(None, axis, None),
-        check_rep=False,
     )
 
     def apply(variables, tokens):

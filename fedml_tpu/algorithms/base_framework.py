@@ -1,0 +1,237 @@
+"""Base-framework template — the tutorial algorithm new algorithms copy.
+
+Reference ``fedml_api/distributed/base_framework/`` (the documented
+starting point for new algorithms): ``algorithm_api.py:16-39`` forks
+process roles, ``central_worker.py:4-32`` collects one scalar "local
+result" per client and sums them, ``central_manager.py:8-53`` runs the
+INIT → collect → aggregate → broadcast round loop over MPI.
+
+The TPU rebuild keeps the template in BOTH native forms so a new
+algorithm can start from whichever coupling it needs:
+
+- **message form** — ``BaseCentralManager`` / ``BaseClientManager``
+  over any ``CommBackend`` (inproc simulation, TCP hub): the
+  loosely-coupled host control plane, reference choreography intact
+  (minus the MPI ``Abort()`` shutdown — a FINISH message instead).
+- **compiled form** — the identical round as ONE jitted
+  ``shard_map``/``psum`` over a ``clients`` mesh axis: what the
+  message choreography compiles down to when every participant is a
+  chip on the slice.
+
+``run_base_framework`` drives the message form; ``make_compiled_round``
+builds the collective form; the test suite asserts they agree exactly.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from fedml_tpu.comm.backend import CommBackend, NodeManager
+from fedml_tpu.comm.inproc import InprocBus
+from fedml_tpu.comm.message import (
+    MSG_ARG_KEY_ROUND_INDEX,
+    MSG_TYPE_S2C_FINISH,
+    MSG_TYPE_S2C_INIT_CONFIG,
+    Message,
+)
+
+SERVER = 0
+
+# template-specific vocabulary (reference base_framework/message_define.py)
+MSG_TYPE_S2C_INFORMATION = "S2C_INFORMATION"
+MSG_TYPE_C2S_INFORMATION = "C2S_INFORMATION"
+MSG_ARG_KEY_INFORMATION = "information"
+
+# a client's contribution given (client_id, round_idx, global_result)
+LocalComputeFn = Callable[[int, int, float], float]
+
+
+def default_local_compute(client_id: int, round_idx: int,
+                          global_result: float) -> float:
+    """Deterministic stand-in "local training": decays the global value
+    and adds a per-client offset, so rounds produce a checkable series."""
+    return 0.5 * global_result / (client_id + 1) + (client_id + 1) * 0.01
+
+
+class BaseCentralWorker:
+    """Scalar aggregator (reference ``central_worker.py:4-32``): collect
+    one local result per client, sum when all have arrived."""
+
+    def __init__(self, client_num: int):
+        self.client_num = client_num
+        self.local_results: Dict[int, float] = {}
+
+    def add_client_local_result(self, index: int, result: float) -> None:
+        self.local_results[index] = result
+
+    def check_whether_all_receive(self) -> bool:
+        return len(self.local_results) == self.client_num
+
+    def aggregate(self) -> float:
+        total = float(sum(self.local_results.values()))
+        self.local_results.clear()
+        return total
+
+
+class BaseClientWorker:
+    """Per-client compute (reference ``client_worker.py``)."""
+
+    def __init__(self, client_id: int,
+                 local_compute: LocalComputeFn = default_local_compute):
+        self.client_id = client_id
+        self.local_compute = local_compute
+
+    def compute(self, round_idx: int, global_result: float) -> float:
+        return self.local_compute(self.client_id, round_idx, global_result)
+
+
+class BaseCentralManager(NodeManager):
+    """Round loop (reference ``central_manager.py:8-53``): INIT to all,
+    collect C2S_INFORMATION, aggregate, broadcast or finish."""
+
+    def __init__(self, backend: CommBackend, aggregator: BaseCentralWorker,
+                 comm_rounds: int):
+        self.aggregator = aggregator
+        self.comm_rounds = comm_rounds
+        self.round_idx = 0
+        self.history: List[float] = []
+        super().__init__(backend)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_INFORMATION, self._on_information
+        )
+
+    def start(self) -> None:
+        for node in range(1, self.aggregator.client_num + 1):
+            self.send_message(
+                Message(MSG_TYPE_S2C_INIT_CONFIG, SERVER, node)
+                .add_params(MSG_ARG_KEY_ROUND_INDEX, 0)
+                .add_params(MSG_ARG_KEY_INFORMATION, 0.0)
+            )
+
+    def _on_information(self, msg: Message) -> None:
+        self.aggregator.add_client_local_result(
+            msg.sender - 1, msg.get(MSG_ARG_KEY_INFORMATION)
+        )
+        if not self.aggregator.check_whether_all_receive():
+            return
+        global_result = self.aggregator.aggregate()
+        self.history.append(global_result)
+        self.round_idx += 1
+        if self.round_idx == self.comm_rounds:
+            for node in range(1, self.aggregator.client_num + 1):
+                self.send_message(Message(MSG_TYPE_S2C_FINISH, SERVER, node))
+            self.finish()
+            return
+        for node in range(1, self.aggregator.client_num + 1):
+            self.send_message(
+                Message(MSG_TYPE_S2C_INFORMATION, SERVER, node)
+                .add_params(MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
+                .add_params(MSG_ARG_KEY_INFORMATION, global_result)
+            )
+
+
+class BaseClientManager(NodeManager):
+    """Client loop (reference ``client_manager.py``): on INIT or
+    S2C_INFORMATION, compute the local result and send it up."""
+
+    def __init__(self, backend: CommBackend, worker: BaseClientWorker):
+        self.worker = worker
+        super().__init__(backend)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_INIT_CONFIG, self._on_round
+        )
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_INFORMATION, self._on_round
+        )
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_FINISH, lambda msg: self.finish()
+        )
+
+    def _on_round(self, msg: Message) -> None:
+        result = self.worker.compute(
+            msg.get(MSG_ARG_KEY_ROUND_INDEX), msg.get(MSG_ARG_KEY_INFORMATION)
+        )
+        self.send_message(
+            Message(MSG_TYPE_C2S_INFORMATION, self.backend.node_id, SERVER)
+            .add_params(MSG_ARG_KEY_INFORMATION, result)
+        )
+
+
+def run_base_framework(
+    num_workers: int,
+    comm_rounds: int,
+    local_compute: LocalComputeFn = default_local_compute,
+) -> List[float]:
+    """Drive the message-form template on the inproc bus; returns the
+    per-round global results (reference's mpirun localhost demo,
+    ``CI-script-framework.sh:16-23``)."""
+    bus = InprocBus()
+    central = BaseCentralManager(
+        bus.register(SERVER), BaseCentralWorker(num_workers), comm_rounds
+    )
+    managers = [
+        BaseClientManager(bus.register(i + 1),
+                          BaseClientWorker(i, local_compute))
+        for i in range(num_workers)
+    ]
+    del managers
+    central.start()
+    bus.drain()
+    return central.history
+
+
+def make_compiled_round(
+    mesh,
+    local_compute_jax: Optional[Callable[[jax.Array, jax.Array, jax.Array],
+                                         jax.Array]] = None,
+    axis: str = "clients",
+):
+    """The SAME template round as one compiled collective: each device
+    computes its clients' local results and a ``psum`` over the mesh
+    axis replaces collect+aggregate+broadcast.
+
+    ``local_compute_jax(client_ids, round_idx, global_result)`` maps the
+    local shard of client ids to local results (vectorized); defaults to
+    the jnp translation of ``default_local_compute``.
+    """
+    if local_compute_jax is None:
+        def local_compute_jax(cid, round_idx, g):
+            return 0.5 * g / (cid + 1.0) + (cid + 1.0) * 0.01
+
+    def _round(client_ids, round_idx, global_result):
+        local = local_compute_jax(client_ids, round_idx, global_result)
+        return lax.psum(jnp.sum(local), axis)
+
+    sharded = jax.shard_map(
+        _round, mesh=mesh, in_specs=(P(axis), P(), P()), out_specs=P()
+    )
+
+    @jax.jit
+    def run_rounds(client_ids, num_rounds_arr):
+        def body(g, r):
+            g = sharded(client_ids, r, g)
+            return g, g
+
+        _, history = lax.scan(
+            body, jnp.asarray(0.0, jnp.float32), num_rounds_arr
+        )
+        return history
+
+    def run(num_clients: int, comm_rounds: int) -> np.ndarray:
+        cids = jnp.arange(num_clients, dtype=jnp.float32)
+        return np.asarray(
+            run_rounds(cids, jnp.arange(comm_rounds, dtype=jnp.int32))
+        )
+
+    return run

@@ -28,6 +28,28 @@ def _flatten_for_npz(tree: PyTree) -> dict:
     return out
 
 
+def _check_leaf_shapes(template: PyTree, restored: PyTree) -> None:
+    """Orbax StandardRestore and the npz path both match tree structure
+    but not leaf shapes; a checkpoint from a differently-sized model
+    would otherwise surface only as a distant jit shape error."""
+    bad = []
+
+    def cmp(path, tpl, val):
+        if tuple(np.shape(tpl)) != tuple(np.shape(val)):
+            bad.append(
+                f"{jax.tree_util.keystr(path)}: saved {np.shape(val)} "
+                f"vs template {np.shape(tpl)}"
+            )
+
+    jax.tree_util.tree_map_with_path(cmp, template, restored)
+    if bad:
+        raise ValueError(
+            "checkpoint leaf shapes do not match the restore template "
+            "(was the model built with different hyperparameters?):\n "
+            + "\n ".join(bad)
+        )
+
+
 class CheckpointManager:
     """Orbax-backed checkpoint manager with an npz fallback.
 
@@ -101,6 +123,7 @@ class CheckpointManager:
             restored = jax.tree_util.tree_unflatten(
                 treedef, [z[f"leaf_{i}"] for i in range(len(leaves))]
             )
+        _check_leaf_shapes(template, restored)
         # match the template's leaf dtypes/types (jnp arrays where needed)
         return jax.tree_util.tree_map(
             lambda tpl, val: np.asarray(val, dtype=np.asarray(tpl).dtype),

@@ -1,0 +1,8 @@
+"""Entry shim — reference parity with ``fedml_experiments/distributed/base_framework``."""
+
+import sys
+
+from fedml_tpu.experiments.run import main
+
+if __name__ == "__main__":
+    main(["--algorithm", "base_framework", *sys.argv[1:]])

@@ -29,7 +29,7 @@ from fedml_tpu.experiments.registry import create_model, load_data
 ALGORITHMS = (
     "fedavg", "fedopt", "fedprox", "fednova", "fedavg_robust",
     "hierarchical", "decentralized", "fedgkt", "fednas", "centralized",
-    "turboaggregate", "splitnn", "vfl",
+    "turboaggregate", "splitnn", "vfl", "base_framework",
 )
 
 
@@ -93,6 +93,13 @@ def _apply_ci(cfg: ExperimentConfig) -> ExperimentConfig:
 def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
     cfg = _apply_ci(cfg)
     t0 = time.time()
+
+    if cfg.algorithm == "base_framework":  # tutorial template: no model/data
+        from fedml_tpu.algorithms.base_framework import run_base_framework
+
+        hist = run_base_framework(cfg.client_num_in_total, cfg.comm_round)
+        return {"history": hist, "final": hist[-1] if hist else None,
+                "wall_s": time.time() - t0}
 
     if cfg.algorithm == "vfl":  # vertical FL uses its own tabular data
         from fedml_tpu.algorithms.vfl import VerticalFederation, run_vfl

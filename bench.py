@@ -30,18 +30,37 @@ def main():
     p.add_argument("--steps", type=int, default=24)
     p.add_argument("--epochs", type=int, default=1)
     p.add_argument("--rounds", type=int, default=3)
+    p.add_argument(
+        "--dtype",
+        default="fp32",
+        help="compute dtype for the local-training forward/backward. "
+        "fp32 is fastest for this small-conv workload (XLA already runs "
+        "fp32 TPU matmuls as bf16 MXU passes; explicit bf16 only adds "
+        "sublane padding on the narrow CIFAR channels — measured 1522 "
+        "vs 892 samples/s on v5e). bf16 pays off for the wide-matmul "
+        "transformer family.",
+    )
     args = p.parse_args()
 
     import jax
     import jax.numpy as jnp
 
-    from fedml_tpu.algorithms.fedavg import ServerState, make_round_fn
+    from fedml_tpu.algorithms.fedavg import (
+        ServerState,
+        make_round_fn,
+        resolve_compute_dtype,
+    )
     from fedml_tpu.core.client import make_client_optimizer, make_local_update
     from fedml_tpu.models.resnet import resnet56
 
     bundle = resnet56(num_classes=10)
     opt = make_client_optimizer("sgd", 0.001, momentum=0.9, weight_decay=0.001)
-    local_update = make_local_update(bundle, opt, epochs=args.epochs)
+    local_update = make_local_update(
+        bundle,
+        opt,
+        epochs=args.epochs,
+        compute_dtype=resolve_compute_dtype(args.dtype),
+    )
     round_fn = jax.jit(make_round_fn(local_update))
 
     rng = np.random.RandomState(0)

@@ -126,7 +126,7 @@ class BaseCentralManager(NodeManager):
         global_result = self.aggregator.aggregate()
         self.history.append(global_result)
         self.round_idx += 1
-        if self.round_idx == self.comm_rounds:
+        if self.round_idx >= self.comm_rounds:
             for node in range(1, self.aggregator.client_num + 1):
                 self.send_message(Message(MSG_TYPE_S2C_FINISH, SERVER, node))
             self.finish()
@@ -176,6 +176,8 @@ def run_base_framework(
     """Drive the message-form template on the inproc bus; returns the
     per-round global results (reference's mpirun localhost demo,
     ``CI-script-framework.sh:16-23``)."""
+    if comm_rounds < 1:
+        raise ValueError(f"comm_rounds must be >= 1, got {comm_rounds}")
     bus = InprocBus()
     central = BaseCentralManager(
         bus.register(SERVER), BaseCentralWorker(num_workers), comm_rounds
@@ -206,8 +208,9 @@ def make_compiled_round(
     the jnp translation of ``default_local_compute``.
     """
     if local_compute_jax is None:
-        def local_compute_jax(cid, round_idx, g):
-            return 0.5 * g / (cid + 1.0) + (cid + 1.0) * 0.01
+        # the default template compute is pure arithmetic, so the message
+        # form and the compiled form share one definition
+        local_compute_jax = default_local_compute
 
     def _round(client_ids, round_idx, global_result):
         local = local_compute_jax(client_ids, round_idx, global_result)

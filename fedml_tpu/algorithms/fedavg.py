@@ -54,6 +54,17 @@ def default_server_update(old, agg, opt_state):
     return agg, opt_state
 
 
+def resolve_compute_dtype(name):
+    """'bf16'/'bfloat16'/'fp32'/None → jnp dtype or None (fp32 = off)."""
+    if name is None or name in ("fp32", "float32"):
+        return None
+    if name in ("bf16", "bfloat16"):
+        return jnp.bfloat16
+    if name in ("fp16", "float16"):
+        return jnp.float16
+    raise ValueError(f"unknown compute dtype: {name!r}")
+
+
 def make_round_fn(
     local_update: LocalUpdateFn,
     *,
@@ -158,6 +169,9 @@ class FedAvgConfig:
     frequency_of_the_test: int = 5
     seed: int = 0
     prox_mu: float = 0.0  # FedProx is FedAvg with mu > 0
+    # mixed precision: "bf16" runs forward/backward in bfloat16 on the MXU
+    # while master params / optimizer state / aggregation stay float32
+    compute_dtype: Optional[str] = None
 
 
 class FedAvgSimulation:
@@ -193,6 +207,7 @@ class FedAvgSimulation:
             weight_decay=config.weight_decay,
             grad_clip=config.grad_clip,
         )
+        cdtype = resolve_compute_dtype(config.compute_dtype)
         self.local_update = local_update or make_local_update(
             bundle,
             optimizer,
@@ -200,6 +215,7 @@ class FedAvgSimulation:
             loss_fn,
             prox_mu=config.prox_mu,
             augment_fn=augment_fn,
+            compute_dtype=cdtype,
         )
         self._server_update = server_update
         self._aggregate_transform = aggregate_transform

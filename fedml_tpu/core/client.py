@@ -79,6 +79,7 @@ def make_local_update(
     prox_mu: float = 0.0,
     shuffle: bool = True,
     augment_fn: Optional[Callable] = None,
+    compute_dtype: Optional[Any] = None,
 ) -> LocalUpdateFn:
     """Build the pure local-update function for one client.
 
@@ -86,11 +87,28 @@ def make_local_update(
     Returns (new_variables, metrics) where metrics carries summed
     loss/correct/count over the final epoch — mirroring what the
     reference logs per client (``MyModelTrainer.py:55-66``).
+
+    ``compute_dtype`` (e.g. ``jnp.bfloat16``) enables mixed precision:
+    the forward/backward pass runs with params and inputs cast to that
+    dtype so matmuls/convs hit the MXU at full rate, while the master
+    params, optimizer state, gradients, and loss stay float32 (losses
+    upcast logits internally).  Mutable state (BatchNorm stats) is cast
+    back to its master dtype each step so the scan carry stays stable.
     """
 
     def loss_and_logits(params, other_vars, global_params, x, y, m, rng):
         variables = {**other_vars, "params": params}
-        logits, new_vars = bundle.apply_train(variables, x, rng)
+        if compute_dtype is not None:
+            cvars = treelib.tree_cast_floats(variables, compute_dtype)
+            cx = (
+                x.astype(compute_dtype)
+                if jnp.issubdtype(x.dtype, jnp.floating)
+                else x
+            )
+            logits, new_vars = bundle.apply_train(cvars, cx, rng)
+            new_vars = treelib.tree_cast_like(new_vars, variables)
+        else:
+            logits, new_vars = bundle.apply_train(variables, x, rng)
         loss, aux = loss_fn(logits, y, m)
         if prox_mu:
             sq = treelib.tree_sq_norm(treelib.tree_sub(params, global_params))
@@ -166,10 +184,20 @@ def make_local_update(
     return LocalUpdateFn(fn=local_update, epochs=epochs)
 
 
-def make_evaluator(bundle: ModelBundle, loss_fn: LossFn = masked_softmax_ce):
+def make_evaluator(
+    bundle: ModelBundle,
+    loss_fn: LossFn = masked_softmax_ce,
+    *,
+    compute_dtype: Optional[Any] = None,
+):
     """Jit-able eval over a padded batch pack [steps, B, ...] → summed metrics."""
 
     def evaluate(variables, x, y, mask):
+        if compute_dtype is not None:
+            variables = treelib.tree_cast_floats(variables, compute_dtype)
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(compute_dtype)
+
         def body(carry, batch):
             bx, by, bm = batch
             logits = bundle.apply_eval(variables, bx)

@@ -83,6 +83,21 @@ def tree_cast(tree: PyTree, dtype) -> PyTree:
     return jax.tree_util.tree_map(lambda x: x.astype(dtype), tree)
 
 
+def tree_cast_floats(tree: PyTree, dtype) -> PyTree:
+    """Cast only floating-point leaves (mixed-precision compute casts;
+    integer leaves such as token ids / step counters pass through)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        tree,
+    )
+
+
+def tree_cast_like(tree: PyTree, ref: PyTree) -> PyTree:
+    """Cast every leaf of ``tree`` to the dtype of the same leaf in ``ref``
+    (restores master dtypes after a low-precision forward pass)."""
+    return jax.tree_util.tree_map(lambda x, r: x.astype(r.dtype), tree, ref)
+
+
 def tree_stack(trees: Sequence[PyTree]) -> PyTree:
     """Stack a list of identically-shaped pytrees along a new axis 0."""
     return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)

@@ -115,3 +115,44 @@ def test_heterogeneous_client_sizes_mask_correct():
     hist = sim.run()
     counts = ds.client_sample_counts()
     assert hist[-1]["count"] == pytest.approx(float(counts.sum()))
+
+
+def test_fedavg_mixed_precision_bf16():
+    """bf16 compute path: masters stay fp32, training still converges,
+    and the bf16 model tracks the fp32 model closely on this small task."""
+    ds = small_ds()
+    bundle = logistic_regression(16, 4)
+    kw = dict(
+        num_clients=4, clients_per_round=4, comm_rounds=15, epochs=1,
+        batch_size=20, lr=0.3, frequency_of_the_test=100,
+    )
+    sim_bf16 = FedAvgSimulation(bundle, ds, FedAvgConfig(compute_dtype="bf16", **kw))
+    sim_fp32 = FedAvgSimulation(bundle, ds, FedAvgConfig(**kw))
+    sim_bf16.run()
+    sim_fp32.run()
+    # master params stayed fp32
+    for leaf in jax.tree_util.tree_leaves(sim_bf16.state.variables):
+        assert leaf.dtype == jnp.float32
+    acc_bf16 = sim_bf16.evaluate_global()["test_acc"]
+    acc_fp32 = sim_fp32.evaluate_global()["test_acc"]
+    assert acc_bf16 > 0.6
+    assert abs(acc_bf16 - acc_fp32) < 0.1
+
+
+def test_mixed_precision_batchnorm_state_stable():
+    """BatchNorm stats must keep fp32 master dtype across the bf16 scan."""
+    from fedml_tpu.core.client import make_client_optimizer, make_local_update
+    from fedml_tpu.models.resnet import resnet20
+
+    bundle = resnet20(num_classes=4, image_size=8)
+    opt = make_client_optimizer("sgd", 0.1)
+    lu = make_local_update(bundle, opt, epochs=1, compute_dtype=jnp.bfloat16)
+    variables = bundle.init(jax.random.PRNGKey(0))
+    x = jnp.zeros((2, 4, 8, 8, 3), jnp.float32)
+    y = jnp.zeros((2, 4), jnp.int32)
+    m = jnp.ones((2, 4), jnp.float32)
+    new_vars, metrics = jax.jit(lu.fn)(variables, x, y, m, jax.random.PRNGKey(1))
+    ref_dtypes = jax.tree_util.tree_map(lambda v: v.dtype, variables)
+    new_dtypes = jax.tree_util.tree_map(lambda v: v.dtype, new_vars)
+    assert ref_dtypes == new_dtypes
+    assert np.isfinite(float(metrics["loss_sum"]))

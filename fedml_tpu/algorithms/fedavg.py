@@ -61,7 +61,11 @@ def resolve_compute_dtype(name):
     if name in ("bf16", "bfloat16"):
         return jnp.bfloat16
     if name in ("fp16", "float16"):
-        return jnp.float16
+        raise ValueError(
+            "float16 compute needs loss scaling (its ~6e-5 normal minimum "
+            "underflows gradients), which this path does not implement; "
+            "use bf16 (same MXU rate, fp32-range exponent)"
+        )
     raise ValueError(f"unknown compute dtype: {name!r}")
 
 

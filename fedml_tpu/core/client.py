@@ -184,20 +184,13 @@ def make_local_update(
     return LocalUpdateFn(fn=local_update, epochs=epochs)
 
 
-def make_evaluator(
-    bundle: ModelBundle,
-    loss_fn: LossFn = masked_softmax_ce,
-    *,
-    compute_dtype: Optional[Any] = None,
-):
-    """Jit-able eval over a padded batch pack [steps, B, ...] → summed metrics."""
+def make_evaluator(bundle: ModelBundle, loss_fn: LossFn = masked_softmax_ce):
+    """Jit-able eval over a padded batch pack [steps, B, ...] → summed metrics.
+
+    Evaluation stays float32 even when training uses a low-precision
+    compute_dtype: metric fidelity is worth the one fp32 forward."""
 
     def evaluate(variables, x, y, mask):
-        if compute_dtype is not None:
-            variables = treelib.tree_cast_floats(variables, compute_dtype)
-            if jnp.issubdtype(x.dtype, jnp.floating):
-                x = x.astype(compute_dtype)
-
         def body(carry, batch):
             bx, by, bm = batch
             logits = bundle.apply_eval(variables, bx)

@@ -36,8 +36,9 @@ StageFn = Callable[[PyTree, jax.Array], jax.Array]
 
 
 def make_pp_mesh(n_devices: Optional[int] = None, axis: str = "pp") -> Mesh:
-    devs = jax.devices()[: n_devices or len(jax.devices())]
-    return Mesh(np.array(devs), (axis,))
+    from fedml_tpu.parallel.spmd import make_1d_mesh
+
+    return make_1d_mesh(n_devices, axis)
 
 
 def stack_stage_params(stage_params_list) -> PyTree:

@@ -31,8 +31,9 @@ PyTree = Any
 
 def make_sequence_mesh(n_devices: Optional[int] = None,
                        axis: str = "sp") -> Mesh:
-    devs = jax.devices()[: n_devices or len(jax.devices())]
-    return Mesh(np.array(devs), (axis,))
+    from fedml_tpu.parallel.spmd import make_1d_mesh
+
+    return make_1d_mesh(n_devices, axis)
 
 
 def sequence_parallel_lm(

@@ -26,6 +26,19 @@ from fedml_tpu.core.client import LocalUpdateFn
 PyTree = Any
 
 
+def make_1d_mesh(n_devices: Optional[int] = None, axis: str = "x") -> Mesh:
+    """1-D mesh over the first n devices (shared by the tp/pp/sp/ep
+    constructors)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} present"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
 def make_client_mesh(
     num_devices: Optional[int] = None, *, model_axis: int = 1, devices=None
 ) -> Mesh:

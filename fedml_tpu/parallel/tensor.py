@@ -38,8 +38,9 @@ PyTree = Any
 
 
 def make_tp_mesh(n_devices: Optional[int] = None, axis: str = "tp") -> Mesh:
-    devs = jax.devices()[: n_devices or len(jax.devices())]
-    return Mesh(np.array(devs), (axis,))
+    from fedml_tpu.parallel.spmd import make_1d_mesh
+
+    return make_1d_mesh(n_devices, axis)
 
 
 def _path_names(path) -> Tuple[str, ...]:

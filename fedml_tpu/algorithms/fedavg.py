@@ -269,12 +269,15 @@ class FedAvgSimulation:
     def run_round(self) -> dict:
         round_idx = int(self.state.round_idx)
         ids = self._sample_ids(round_idx)
+        # reuse_buffers: the pack is device_put immediately below, so the
+        # cached host buffers are free to be overwritten next round
         pack = pack_clients(
             self.dataset,
             ids,
             self.cfg.batch_size,
             steps_per_epoch=self.steps_per_epoch,
             seed=self.cfg.seed + round_idx,
+            reuse_buffers=True,
         )
         participation = jnp.ones(len(ids), jnp.float32)
         self.state, metrics = self.round_fn(

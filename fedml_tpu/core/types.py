@@ -127,6 +127,26 @@ class ClientBatches:
         return int(self.x.shape[2])
 
 
+# reusable gather targets for fixed-geometry round loops; one buffer per
+# role tag, replaced when the requested geometry changes — bounded at
+# (number of tags) live buffers no matter how many shapes a sweep visits
+_pack_buffer_cache: dict = {}
+
+
+def _gather_target(tag: str, shape, dtype, reuse: bool):
+    if not reuse:
+        return None
+    # tag keeps roles distinct: x and y packs with identical shape+dtype
+    # must not share one buffer
+    shape = tuple(shape)
+    dtype = np.dtype(dtype)
+    buf = _pack_buffer_cache.get(tag)
+    if buf is None or buf.shape != shape or buf.dtype != dtype:
+        buf = np.empty(shape, dtype)
+        _pack_buffer_cache[tag] = buf
+    return buf
+
+
 def pack_clients(
     dataset: FedDataset,
     client_ids: Sequence[int],
@@ -134,6 +154,7 @@ def pack_clients(
     *,
     steps_per_epoch: Optional[int] = None,
     seed: int = 0,
+    reuse_buffers: bool = False,
 ) -> ClientBatches:
     """Pack the named clients' train shards into one fixed-shape block.
 
@@ -142,44 +163,71 @@ def pack_clients(
     ``steps_per_epoch * batch_size`` length; the mask marks only the first
     ``n_c`` slots per client as real.  Wrapped duplicates keep BatchNorm
     inputs realistic while contributing zero loss/weight.
+
+    ``reuse_buffers=True`` gathers into process-cached host buffers
+    instead of fresh allocations — ~4x faster per round (allocation +
+    page-fault churn dominates the copy).  Only safe when the caller
+    consumes the pack before the next same-shape pack_clients call
+    (e.g. immediately device_puts it, as the round drivers do): the
+    returned arrays are OVERWRITTEN by that next call.
     """
+    from fedml_tpu.native import gather_rows
+
     counts = [len(dataset.train_client_idx[c]) for c in client_ids]
     if steps_per_epoch is None:
         steps_per_epoch = max(1, int(np.ceil(max(max(counts), 1) / batch_size)))
     total = steps_per_epoch * batch_size
+    K = len(client_ids)
 
-    xs, ys, ms, ns = [], [], [], []
-    feat_shape = dataset.train_x.shape[1:]
-    for c in client_ids:
+    # pass 1 (cheap): per-client wrapped index lists + masks
+    wrapped_all = np.zeros((K, total), dtype=np.int64)
+    mask = np.zeros((K, total), dtype=np.float32)
+    ns = np.zeros(K, dtype=np.float32)
+    for k, c in enumerate(client_ids):
         # per-client seeding: a client's pack is identical whether packed
         # alone (cross-device manager) or in a cohort (simulation/SPMD)
         rng = np.random.RandomState((seed * 1000003 + int(c) * 7919 + 1) % (2**31))
         idx = np.asarray(dataset.train_client_idx[c])
         n = len(idx)
-        if n == 0:
-            # an empty client contributes nothing; fill with sample 0, mask 0
-            wrapped = np.zeros(total, dtype=np.int64)
-            mask = np.zeros(total, dtype=np.float32)
-        else:
-            idx = rng.permutation(idx)
-            wrapped = np.resize(idx, total)
-            mask = np.zeros(total, dtype=np.float32)
-            mask[: min(n, total)] = 1.0
-        xs.append(dataset.train_x[wrapped].reshape(steps_per_epoch, batch_size, *feat_shape))
-        # y may carry trailing dims (sequence targets [N, T], tag vectors)
-        ys.append(
-            dataset.train_y[wrapped].reshape(
-                steps_per_epoch, batch_size, *dataset.train_y.shape[1:]
-            )
-        )
-        ms.append(mask.reshape(steps_per_epoch, batch_size))
-        ns.append(min(n, total))
+        if n:
+            # gather_rows clamps out-of-range rows (segfault defense), so
+            # validate here to keep the old fancy-indexing error behavior
+            lo, hi = int(idx.min()), int(idx.max())
+            if lo < 0 or hi >= len(dataset.train_x):
+                raise IndexError(
+                    f"client {c} sample indices [{lo}, {hi}] out of range "
+                    f"for train_x with {len(dataset.train_x)} rows"
+                )
+            # empty clients keep sample 0 / mask 0 and contribute nothing
+            wrapped_all[k] = np.resize(rng.permutation(idx), total)
+            mask[k, : min(n, total)] = 1.0
+            ns[k] = min(n, total)
+
+    # pass 2 (hot): one fused row gather per tensor straight into the
+    # packed block — threaded C++ when available, numpy otherwise
+    feat_shape = dataset.train_x.shape[1:]
+    x_out = _gather_target(
+        "x", (K * total, *feat_shape), dataset.train_x.dtype, reuse_buffers
+    )
+    x = gather_rows(dataset.train_x, wrapped_all, x_out).reshape(
+        K, steps_per_epoch, batch_size, *feat_shape
+    )
+    # y may carry trailing dims (sequence targets [N, T], tag vectors)
+    y_out = _gather_target(
+        "y",
+        (K * total, *dataset.train_y.shape[1:]),
+        dataset.train_y.dtype,
+        reuse_buffers,
+    )
+    y = gather_rows(dataset.train_y, wrapped_all, y_out).reshape(
+        K, steps_per_epoch, batch_size, *dataset.train_y.shape[1:]
+    )
 
     return ClientBatches(
-        x=np.stack(xs),
-        y=np.stack(ys),
-        mask=np.stack(ms),
-        num_samples=np.array(ns, dtype=np.float32),
+        x=x,
+        y=y,
+        mask=mask.reshape(K, steps_per_epoch, batch_size),
+        num_samples=ns,
     )
 
 

@@ -1,0 +1,14 @@
+"""Native (C++) host-runtime components.
+
+The compute path of the framework is JAX/XLA (compiled native code by
+construction); these are the HOST-side pieces where Python/numpy is the
+bottleneck — currently the per-round client-shard packer
+(``gather_rows``).  Built on demand with the system ``g++`` via ctypes
+(no pip/pybind dependency); every entry point has a pure-numpy fallback
+so the framework works identically where no toolchain exists
+(``FEDML_TPU_NO_NATIVE=1`` forces the fallback).
+"""
+
+from fedml_tpu.native.packer import gather_rows, native_available
+
+__all__ = ["gather_rows", "native_available"]

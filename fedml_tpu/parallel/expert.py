@@ -75,7 +75,6 @@ def make_moe_ffn(mesh: Mesh, capacity: int, axis: str = "ep"):
     def local(params, x):
         # params local shard: w_in/w_out [1, d, h]; gate replicated
         w_in, w_out = params["w_in"][0], params["w_out"][0]
-        t = x.shape[0]  # local tokens
         logits = x @ params["gate"]  # [t, E]
         probs = jax.nn.softmax(logits, axis=-1)
         expert = jnp.argmax(logits, axis=-1)  # [t] top-1

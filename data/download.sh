@@ -1,0 +1,71 @@
+#!/usr/bin/env bash
+# Dataset fetcher for fedml_tpu — the role of the reference's per-dataset
+# download_*.sh scripts (fedml/data/*/download_*.sh, driven by
+# CI-install.sh). One entry point, one dataset per argument; each target
+# downloads into the layout its loader documents
+# (fedml_tpu/data/<loader>.py docstrings). With no network the loaders
+# fall back to shape-faithful synthetic stand-ins (flagged synthetic=True).
+#
+# Usage: ./download.sh [mnist|cifar10|cifar100|cinic10|femnist|
+#                      fed_cifar100|shakespeare|fed_shakespeare|
+#                      stackoverflow|stackoverflow_lr|all]
+set -euo pipefail
+cd "$(dirname "$0")"
+
+TFF=https://storage.googleapis.com/tff-datasets-public
+
+fetch() { # fetch <dir> <url> [unpack]
+  local dir=$1 url=$2 unpack=${3:-}
+  mkdir -p "$dir"
+  local f="$dir/$(basename "$url")"
+  [ -f "$f" ] || wget -q --show-progress -O "$f" "$url"
+  case "$unpack" in
+    tgz) tar -xzf "$f" -C "$dir" ;;
+    gz)  gunzip -kf "$f" ;;
+    tbz) tar -xjf "$f" -C "$dir" ;;
+  esac
+}
+
+mnist() {
+  # raw IDX files (fedml_tpu/data/mnist.py reads *-ubyte[.gz])
+  for f in train-images-idx3-ubyte train-labels-idx1-ubyte \
+           t10k-images-idx3-ubyte t10k-labels-idx1-ubyte; do
+    fetch mnist "https://ossci-datasets.s3.amazonaws.com/mnist/$f.gz" gz
+  done
+}
+
+cifar10()  { fetch cifar10  https://www.cs.toronto.edu/~kriz/cifar-10-python.tar.gz  tgz; }
+cifar100() { fetch cifar100 https://www.cs.toronto.edu/~kriz/cifar-100-python.tar.gz tgz; }
+
+cinic10() {
+  fetch cinic10 https://datashare.ed.ac.uk/bitstream/handle/10283/3192/CINIC-10.tar.gz tgz
+  echo "note: convert the ImageFolder tree to cinic10.npz" \
+       "(x_train/y_train/x_test/y_test) — see fedml_tpu/data/cifar.py"
+}
+
+femnist()         { fetch FederatedEMNIST/datasets $TFF/fed_emnist.tar.bz2 tbz; }
+fed_cifar100()    { fetch fed_cifar100/datasets    $TFF/fed_cifar100.tar.bz2 tbz; }
+fed_shakespeare() { fetch fed_shakespeare/datasets $TFF/shakespeare.tar.bz2 tbz; }
+stackoverflow()   { fetch stackoverflow/datasets    $TFF/stackoverflow.tar.bz2 tbz; }
+stackoverflow_lr(){ fetch stackoverflow_lr/datasets $TFF/stackoverflow.tag_count.tar.bz2 tbz; }
+
+shakespeare() {
+  echo "LEAF shakespeare: generate with the LEAF toolkit" \
+       "(github.com/TalwalkarLab/leaf, data/shakespeare/preprocess.sh)" \
+       "then place all_data_*.json under shakespeare/{train,test}/"
+}
+
+all() {
+  mnist; cifar10; cifar100; cinic10; femnist; fed_cifar100
+  shakespeare; fed_shakespeare; stackoverflow; stackoverflow_lr
+}
+
+TARGETS="mnist cifar10 cifar100 cinic10 femnist fed_cifar100 shakespeare \
+fed_shakespeare stackoverflow stackoverflow_lr all"
+
+for target in "${@:-all}"; do
+  case " $TARGETS " in
+    *" $target "*) "$target" ;;
+    *) echo "unknown dataset: $target"; echo "targets: $TARGETS"; exit 1 ;;
+  esac
+done

@@ -28,16 +28,22 @@ _tried = False
 
 
 def _build() -> bool:
+    # compile to a process-unique temp path and rename into place:
+    # concurrent processes (pytest-xdist, multi-process launches) must
+    # never dlopen a partially-written .so
+    tmp = _LIB.with_suffix(f".tmp.{os.getpid()}.so")
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-pthread",
-        str(_SRC), "-o", str(_LIB),
+        str(_SRC), "-o", str(tmp),
     ]
     try:
         subprocess.run(
             cmd, check=True, capture_output=True, timeout=120
         )
+        os.replace(tmp, _LIB)
         return True
     except (OSError, subprocess.SubprocessError):
+        tmp.unlink(missing_ok=True)
         return False
 
 

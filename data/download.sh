@@ -18,12 +18,21 @@ fetch() { # fetch <dir> <url> [unpack]
   local dir=$1 url=$2 unpack=${3:-}
   mkdir -p "$dir"
   local f="$dir/$(basename "$url")"
-  [ -f "$f" ] || wget -q --show-progress -O "$f" "$url"
+  # download to .part then move: an interrupted run never leaves a
+  # corrupt archive that later runs would trust
+  if [ ! -f "$f" ]; then
+    wget -q --show-progress -O "$f.part" "$url"
+    mv "$f.part" "$f"
+  fi
+  # unpack once: the sentinel marks a completed extraction
+  local done="$f.unpacked"
+  if [ -z "$unpack" ] || [ -f "$done" ]; then return 0; fi
   case "$unpack" in
     tgz) tar -xzf "$f" -C "$dir" ;;
     gz)  gunzip -kf "$f" ;;
     tbz) tar -xjf "$f" -C "$dir" ;;
   esac
+  touch "$done"
 }
 
 mnist() {
@@ -47,7 +56,13 @@ femnist()         { fetch FederatedEMNIST/datasets $TFF/fed_emnist.tar.bz2 tbz; 
 fed_cifar100()    { fetch fed_cifar100/datasets    $TFF/fed_cifar100.tar.bz2 tbz; }
 fed_shakespeare() { fetch fed_shakespeare/datasets $TFF/shakespeare.tar.bz2 tbz; }
 stackoverflow()   { fetch stackoverflow/datasets    $TFF/stackoverflow.tar.bz2 tbz; }
-stackoverflow_lr(){ fetch stackoverflow_lr/datasets $TFF/stackoverflow.tag_count.tar.bz2 tbz; }
+stackoverflow_lr(){
+  fetch stackoverflow_lr/datasets $TFF/stackoverflow.tar.bz2 tbz
+  fetch stackoverflow_lr/datasets $TFF/stackoverflow.tag_count.tar.bz2 tbz
+  echo "note: build stackoverflow_lr_train.h5 (x/y/client_ptr; 500-dim" \
+       "bag-of-words -> 500 tag targets) from the TFF h5 + tag_count" \
+       "vocab — see fedml_tpu/data/stackoverflow.py load_stackoverflow_lr"
+}
 
 shakespeare() {
   echo "LEAF shakespeare: generate with the LEAF toolkit" \

@@ -10,6 +10,12 @@ figure: PyTorch ResNet-56/CIFAR-10 training on the RTX-2080-Ti-class
 GPUs the reference's cluster used sustains roughly 1500 samples/s per
 GPU (per-client serial training, as in the reference's one-process-per-
 client design). vs_baseline = our samples/s / 1500.
+
+Timing methodology: warm up until two consecutive fully-synced rounds
+agree (the device-committed-state signature recompile AND a one-off
+slow execution both hide in naive warmups), then report the median of
+fully block_until_ready'd per-round wall-clocks.  Measured steady
+state on one v5e chip: ~18.2k samples/s bf16, ~11.8k fp32.
 """
 
 from __future__ import annotations
@@ -29,16 +35,15 @@ def main():
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--steps", type=int, default=24)
     p.add_argument("--epochs", type=int, default=1)
-    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--rounds", type=int, default=5)
     p.add_argument(
         "--dtype",
-        default="fp32",
+        default="bf16",
         help="compute dtype for the local-training forward/backward. "
-        "fp32 is fastest for this small-conv workload (XLA already runs "
-        "fp32 TPU matmuls as bf16 MXU passes; explicit bf16 only adds "
-        "sublane padding on the narrow CIFAR channels — measured 1522 "
-        "vs 892 samples/s on v5e). bf16 pays off for the wide-matmul "
-        "transformer family.",
+        "bf16 = mixed precision (fp32 masters/optimizer/aggregation): "
+        "18.2k samples/s steady-state on v5e vs 11.8k for fp32 (1.54x); "
+        "convergence parity with fp32 is unit-tested "
+        "(tests/test_fedavg.py::test_fedavg_mixed_precision_bf16).",
     )
     args = p.parse_args()
 
@@ -80,25 +85,40 @@ def main():
         key=key,
     )
 
-    # warmup / compile — two threaded rounds: the second input signature
-    # (device-committed state) compiles separately from the first
-    for _ in range(2):
+    # warmup: the second input signature (device-committed state)
+    # compiles separately from the first, and on the axon tunnel one
+    # more slow execution (~6s) follows even after a full block — warm
+    # until two consecutive rounds agree within 20%
+    prev = None
+    for i in range(6):
+        t0 = time.perf_counter()
         state, _ = round_fn(state, x, y, mask, num_samples, participation, slot_ids)
-    jax.block_until_ready(state.variables)
+        jax.block_until_ready(state.variables)
+        dt = time.perf_counter() - t0
+        # agreement counts only from round 3 on: the two compile rounds
+        # (one per input signature) can agree with each other while the
+        # slow post-compile execution is still ahead
+        if i >= 2 and prev is not None and abs(dt - prev) / max(dt, prev) < 0.2:
+            break
+        prev = dt
 
-    t0 = time.perf_counter()
+    # median of fully-synced per-round wall-clocks: robust to one-off
+    # tunnel/host hiccups, and block_until_ready on the whole state
+    # means nothing escapes the timed region asynchronously
+    times = []
     loss = 0.0
     for _ in range(args.rounds):
+        t0 = time.perf_counter()
         state, metrics = round_fn(
             state, x, y, mask, num_samples, participation, slot_ids
         )
-        loss = float(metrics["loss_sum"])  # forced readback: no async escape
-    jax.block_until_ready(state.variables)
-    dt = time.perf_counter() - t0
+        jax.block_until_ready((state.variables, metrics))
+        times.append(time.perf_counter() - t0)
+        loss = float(metrics["loss_sum"])
     assert np.isfinite(loss)
 
-    samples = C * S * B * args.epochs * args.rounds
-    sps = samples / dt
+    samples_per_round = C * S * B * args.epochs
+    sps = samples_per_round / float(np.median(times))
     print(
         json.dumps(
             {

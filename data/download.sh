@@ -57,11 +57,12 @@ fed_cifar100()    { fetch fed_cifar100/datasets    $TFF/fed_cifar100.tar.bz2 tbz
 fed_shakespeare() { fetch fed_shakespeare/datasets $TFF/shakespeare.tar.bz2 tbz; }
 stackoverflow()   { fetch stackoverflow/datasets    $TFF/stackoverflow.tar.bz2 tbz; }
 stackoverflow_lr(){
-  fetch stackoverflow_lr/datasets $TFF/stackoverflow.tar.bz2 tbz
   fetch stackoverflow_lr/datasets $TFF/stackoverflow.tag_count.tar.bz2 tbz
   echo "note: build stackoverflow_lr_train.h5 (x/y/client_ptr; 500-dim" \
-       "bag-of-words -> 500 tag targets) from the TFF h5 + tag_count" \
-       "vocab — see fedml_tpu/data/stackoverflow.py load_stackoverflow_lr"
+       "bag-of-words -> 500 tag targets) from the stackoverflow target's" \
+       "h5 (run './download.sh stackoverflow' first; no second copy is" \
+       "fetched) + this tag_count vocab — see" \
+       "fedml_tpu/data/stackoverflow.py load_stackoverflow_lr"
 }
 
 shakespeare() {

@@ -18,7 +18,7 @@ no analogue (SURVEY.md §2.6).
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 import numpy as np
@@ -45,6 +45,31 @@ def make_dp_tp_mesh(
     return Mesh(arr, axis_names=("clients", "model"))
 
 
+def opt_state_sharding_like(
+    mesh: Mesh,
+    variables_template: PyTree,
+    opt_state_template: PyTree,
+    axis: str = "model",
+) -> PyTree:
+    """Sharding tree for server-optimizer state whose leaves mirror the
+    parameters (FedAdam/FedYogi moments): each opt leaf with the shape
+    of some param leaf inherits that param's TP spec; everything else
+    (counts, scalars) is replicated.  Shape-based matching is a
+    heuristic — two same-shaped params with different specs resolve to
+    whichever appears first, which only changes layout, not values."""
+    pspec = tp_param_spec(variables_template, axis)
+    shape_to_spec = {}
+    for leaf, spec in zip(
+        jax.tree_util.tree_leaves(variables_template),
+        jax.tree_util.tree_leaves(pspec, is_leaf=lambda x: isinstance(x, P)),
+    ):
+        shape_to_spec.setdefault(np.shape(leaf), spec)
+    return jax.tree_util.tree_map(
+        lambda l: NamedSharding(mesh, shape_to_spec.get(np.shape(l), P())),
+        opt_state_template,
+    )
+
+
 def make_dp_tp_round_fn(
     mesh: Mesh,
     local_update: LocalUpdateFn,
@@ -52,6 +77,7 @@ def make_dp_tp_round_fn(
     *,
     server_update=None,
     aggregate_transform=None,
+    opt_state_sharding: Optional[PyTree] = None,
 ):
     """jit the FedAvg round with data over ``clients`` and transformer
     params over ``model``.
@@ -61,6 +87,11 @@ def make_dp_tp_round_fn(
     ``shard_state(state)`` lays server state out on the mesh;
     ``shard_data(arrays)`` shards the packed client block.  The returned
     state from ``round_fn`` keeps the same shardings (donated input).
+
+    When a ``server_update`` carries parameter-sized optimizer state
+    (FedAdam moments), pass ``opt_state_sharding`` (see
+    ``opt_state_sharding_like``) — the default replicates opt_state,
+    which would defeat the bigger-than-one-chip purpose for such state.
     """
     kwargs = {}
     if server_update is not None:
@@ -84,20 +115,14 @@ def make_dp_tp_round_fn(
     data_sharding = NamedSharding(mesh, P("clients"))
 
     state_sharding = ServerState(
-        variables=var_sharding, opt_state=repl, round_idx=repl, key=repl
+        variables=var_sharding,
+        opt_state=opt_state_sharding if opt_state_sharding is not None else repl,
+        round_idx=repl,
+        key=repl,
     )
 
     def shard_state(state: ServerState) -> ServerState:
-        return ServerState(
-            variables=jax.tree_util.tree_map(
-                lambda v, s: jax.device_put(v, s),
-                state.variables,
-                var_sharding,
-            ),
-            opt_state=jax.device_put(state.opt_state, repl),
-            round_idx=jax.device_put(state.round_idx, repl),
-            key=jax.device_put(state.key, repl),
-        )
+        return jax.device_put(state, state_sharding)
 
     def shard_data(arrays):
         return tuple(jax.device_put(np.asarray(a), data_sharding)

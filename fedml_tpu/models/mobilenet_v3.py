@@ -140,6 +140,10 @@ class MobileNetV3(nn.Module):
             )(x, train)
         head = 960 if self.model_mode == "LARGE" else 576
         x = nn.Conv(make_divisible(head * m), (1, 1))(x)
+        if self.model_mode == "SMALL":
+            # reference SMALL head squeezes before its BN
+            # (mobilenet_v3.py:226-231 out_conv1 = Conv+SqueezeBlock+BN)
+            x = SqueezeExcite()(x)
         x = h_swish(_bn(train)(x))
         x = jnp.mean(x, axis=(1, 2), keepdims=True)
         x = h_swish(nn.Conv(make_divisible(1280 * m), (1, 1))(x))

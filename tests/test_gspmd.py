@@ -88,13 +88,11 @@ def test_dp_tp_fedadam_server_opt_state_sharded():
     """FedAdam moments mirror the params, so their sharding must follow
     the TP plan rather than be replicated (bigger-than-one-chip server
     state)."""
-    import optax
-
     from fedml_tpu.algorithms.fedopt import make_fedopt_server_update
     from fedml_tpu.core.optrepo import get_server_optimizer
     from fedml_tpu.parallel.gspmd import opt_state_sharding_like
 
-    bundle, local_update, state, args = _setup()
+    _, local_update, state, args = _setup()
     server_opt = get_server_optimizer("adam", lr=0.01)
     opt_state = server_opt.init(state.variables["params"])
     state = ServerState(

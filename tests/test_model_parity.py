@@ -1,0 +1,96 @@
+"""Parameter-count parity against the reference model zoo.
+
+Expected values were measured by instantiating the reference's torch
+models (sum of p.numel() over parameters()) at the cited constructors:
+
+- LogisticRegression(784, 10)                  model/linear/lr.py:4
+- CNN_OriginalFedAvg(False), CNN_DropOut(False)  model/cv/cnn.py:5,72
+- resnet56(10), resnet110(10)                  model/cv/resnet.py
+- resnet18(group_norm=2) (1000 classes)        model/cv/resnet_gn.py:183
+- mobilenet(class_num=10)                      model/cv/mobilenet.py:207
+- MobileNetV3(model_mode=..., num_classes=10)  model/cv/mobilenet_v3.py
+- EfficientNet.from_name('efficientnet-b0', num_classes=10)
+                                               model/cv/efficientnet.py:318
+- vgg11(), vgg16_bn() (1000 classes)           model/cv/vgg.py
+- RNN_OriginalFedAvg(), RNN_StackOverFlow()    model/nlp/rnn.py:4,39
+- resnet8_56(c=10), resnet56_server(c=10)      model/cv/resnet56_gkt/
+
+Known, documented deltas (flax vs torch conventions, not architecture):
+- LSTMs: torch keeps a redundant second bias vector per layer
+  (b_ih AND b_hh); flax has one. Delta = 4*hidden per layer exactly.
+- GKT server: the reference's server net carries a 3->16 stem conv+BN
+  it never uses (it consumes client feature maps); ours omits it
+  (delta 464 = 432 conv + 32 BN affine).
+- resnet18_gn / mobilenet / mobilenet_v3: <0.2% from BN/GN affine
+  placement differences.
+"""
+
+import jax
+import pytest
+
+from fedml_tpu.core.tree import tree_size
+
+
+def _params(bundle):
+    return tree_size(bundle.init(jax.random.PRNGKey(0))["params"])
+
+
+def make_cases():
+    from fedml_tpu.models.cnn import cnn_dropout, cnn_original_fedavg
+    from fedml_tpu.models.efficientnet import efficientnet
+    from fedml_tpu.models.linear import logistic_regression
+    from fedml_tpu.models.mobilenet import mobilenet
+    from fedml_tpu.models.mobilenet_v3 import mobilenet_v3
+    from fedml_tpu.models.resnet import resnet56, resnet110
+    from fedml_tpu.models.resnet_gn import resnet18_gn
+    from fedml_tpu.models.rnn import rnn_shakespeare, rnn_stackoverflow
+    from fedml_tpu.models.vgg import vgg11, vgg16_bn
+
+    # (name, bundle_fn, reference_count, tolerance)
+    return [
+        ("lr_mnist", lambda: logistic_regression(784, 10), 7850, 0),
+        ("cnn_femnist", lambda: cnn_original_fedavg(only_digits=False),
+         1690046, 0),
+        ("cnn_dropout", lambda: cnn_dropout(only_digits=False), 1206590, 0),
+        ("resnet56_c10", lambda: resnet56(num_classes=10), 591322, 0),
+        ("resnet110_c10", lambda: resnet110(num_classes=10), 1147738, 0),
+        ("efficientnet_b0_c10",
+         lambda: efficientnet("efficientnet-b0", num_classes=10), 4020358, 0),
+        ("vgg11_1000", lambda: vgg11(), 132863336, 0),
+        ("vgg16bn_1000", lambda: vgg16_bn(), 138365992, 0),
+        # documented-delta rows (see module docstring)
+        ("rnn_shakespeare", rnn_shakespeare, 822570, 2048),
+        ("rnn_stackoverflow", rnn_stackoverflow, 4053428, 2680),
+        ("resnet18gn_1000",
+         lambda: resnet18_gn(num_classes=1000), 11684712, 0.002),
+        ("mobilenet_c10", lambda: mobilenet(num_classes=10), 3223178, 0.002),
+        ("mnv3_large_c10",
+         lambda: mobilenet_v3(num_classes=10, model_mode="LARGE"),
+         3884328, 0.002),
+        ("mnv3_small_c10",
+         lambda: mobilenet_v3(num_classes=10, model_mode="SMALL"),
+         1843272, 0.002),
+    ]
+
+
+@pytest.mark.parametrize("name,fn,ref,tol", make_cases(),
+                         ids=[c[0] for c in make_cases()])
+def test_param_count_parity(name, fn, ref, tol):
+    ours = _params(fn())
+    if tol == 0:
+        assert ours == ref, f"{name}: {ours} != reference {ref}"
+    elif isinstance(tol, float):
+        rel = abs(ours - ref) / ref
+        assert rel <= tol, f"{name}: {ours} vs {ref} ({rel:.2%} > {tol:.2%})"
+    else:
+        assert abs(ours - ref) <= tol, f"{name}: {ours} vs {ref} (> {tol})"
+
+
+def test_gkt_split_counts():
+    """Client net exact; server net = reference minus its unused stem."""
+    from fedml_tpu.models.resnet_gkt import resnet8_56, resnet56_server
+
+    client = resnet8_56(num_classes=10)
+    assert tree_size(client.init(jax.random.PRNGKey(0))["params"]) == 10586
+    server = _params(resnet56_server(num_classes=10))
+    assert server == 591322 - 464  # reference count minus unused stem

@@ -11,18 +11,19 @@ GPUs the reference's cluster used sustains roughly 1500 samples/s per
 GPU (per-client serial training, as in the reference's one-process-per-
 client design). vs_baseline = our samples/s / 1500.
 
-Timing methodology: warm up until two consecutive fully-synced rounds
-agree (the device-committed-state signature recompile AND a one-off
-slow execution both hide in naive warmups), then report the median of
-fully block_until_ready'd per-round wall-clocks.  Measured steady
-state on one v5e chip: ~18.2k samples/s bf16, ~11.8k fp32.
+Timing methodology (shared: fedml_tpu/utils/timing.py): warm up until
+two consecutive fully-synced rounds agree (the device-committed-state
+signature recompile AND a one-off slow execution both hide in naive
+warmups), then report the median per-round wall-clock with the scalar
+readback inside the timed window (block_until_ready alone can return
+early on the axon tunnel).  Measured steady state on one v5e chip:
+~19k samples/s bf16, ~12k fp32.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
-import time
 
 import numpy as np
 
@@ -31,7 +32,10 @@ REFERENCE_GPU_SAMPLES_PER_SEC = 1500.0
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--clients", type=int, default=4)
+    # 10 clients all participating = the reference's cross-silo ResNet-56
+    # benchmark cohort (BASELINE.md: "10 clients all participating,
+    # E=20, batch 64")
+    p.add_argument("--clients", type=int, default=10)
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--steps", type=int, default=24)
     p.add_argument("--epochs", type=int, default=1)
@@ -85,40 +89,19 @@ def main():
         key=key,
     )
 
-    # warmup: the second input signature (device-committed state)
-    # compiles separately from the first, and on the axon tunnel one
-    # more slow execution (~6s) follows even after a full block — warm
-    # until two consecutive rounds agree within 20%
-    prev = None
-    for i in range(6):
-        t0 = time.perf_counter()
-        state, _ = round_fn(state, x, y, mask, num_samples, participation, slot_ids)
-        jax.block_until_ready(state.variables)
-        dt = time.perf_counter() - t0
-        # agreement counts only from round 3 on: the two compile rounds
-        # (one per input signature) can agree with each other while the
-        # slow post-compile execution is still ahead
-        if i >= 2 and prev is not None and abs(dt - prev) / max(dt, prev) < 0.2:
-            break
-        prev = dt
+    # shared methodology (fedml_tpu/utils/timing.py): warm until two
+    # consecutive fully-synced rounds agree, then median of per-round
+    # times with the scalar readback INSIDE the timed window
+    from fedml_tpu.utils.timing import measure_rounds
 
-    # median of fully-synced per-round wall-clocks: robust to one-off
-    # tunnel/host hiccups, and block_until_ready on the whole state
-    # means nothing escapes the timed region asynchronously
-    times = []
-    loss = 0.0
-    for _ in range(args.rounds):
-        t0 = time.perf_counter()
-        state, metrics = round_fn(
-            state, x, y, mask, num_samples, participation, slot_ids
-        )
-        jax.block_until_ready((state.variables, metrics))
-        times.append(time.perf_counter() - t0)
-        loss = float(metrics["loss_sum"])
-    assert np.isfinite(loss)
-
+    med, state = measure_rounds(
+        round_fn,
+        state,
+        (x, y, mask, num_samples, participation, slot_ids),
+        args.rounds,
+    )
     samples_per_round = C * S * B * args.epochs
-    sps = samples_per_round / float(np.median(times))
+    sps = samples_per_round / med
     print(
         json.dumps(
             {

@@ -1,0 +1,60 @@
+"""Shared benchmark timing methodology (bench.py, tools/bench_scaling.py).
+
+Two axon-tunnel hazards, both observed live on this project:
+- ``jax.block_until_ready`` alone can return before the work is done
+  (timings come out ~45x too fast) — every timed region must ALSO
+  force a scalar readback.
+- After the two warmup compiles (one per input signature) a one-off
+  ~6s slow execution can still follow — warm up until two consecutive
+  fully-synced rounds agree, or aggregate timings are dominated by it.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Tuple
+
+import numpy as np
+
+
+def sync_round(state: Any, metrics: Any) -> float:
+    """Full sync the tunnel can't fake: block AND read a scalar back."""
+    import jax
+
+    jax.block_until_ready((state, metrics))
+    return float(np.sum(jax.tree_util.tree_leaves(metrics)[0]))
+
+
+def measure_rounds(
+    round_fn: Callable,
+    state: Any,
+    args_dev: Tuple,
+    rounds: int,
+    *,
+    max_warmup: int = 6,
+    agree_rtol: float = 0.2,
+) -> Tuple[float, Any]:
+    """(median seconds per fully-synced round, final state)."""
+    prev = None
+    for i in range(max_warmup):
+        t0 = time.perf_counter()
+        state, m = round_fn(state, *args_dev)
+        sync_round(state, m)
+        dt = time.perf_counter() - t0
+        # agreement counts only from round 3 on: the two compile rounds
+        # can agree with each other while the slow post-compile
+        # execution is still ahead
+        if i >= 2 and prev is not None and abs(dt - prev) / max(dt, prev) < agree_rtol:
+            break
+        prev = dt
+    times = []
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        state, m = round_fn(state, *args_dev)
+        scalar = sync_round(state, m)
+        times.append(time.perf_counter() - t0)
+        if not np.isfinite(scalar):
+            raise FloatingPointError(
+                f"benchmark round produced non-finite metrics: {scalar}"
+            )
+    return float(np.median(times)), state

@@ -45,7 +45,7 @@ def main():
         default="bf16",
         help="compute dtype for the local-training forward/backward. "
         "bf16 = mixed precision (fp32 masters/optimizer/aggregation): "
-        "18.2k samples/s steady-state on v5e vs 11.8k for fp32 (1.54x); "
+        "~19k samples/s steady-state on v5e vs ~12k for fp32 (~1.5x); "
         "convergence parity with fp32 is unit-tested "
         "(tests/test_fedavg.py::test_fedavg_mixed_precision_bf16).",
     )

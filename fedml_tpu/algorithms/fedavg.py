@@ -176,6 +176,10 @@ class FedAvgConfig:
     # mixed precision: "bf16" runs forward/backward in bfloat16 on the MXU
     # while master params / optimizer state / aggregation stay float32
     compute_dtype: Optional[str] = None
+    # failure injection (SURVEY §5.3): each sampled client independently
+    # drops mid-round with this probability; masked-psum aggregation
+    # excludes them exactly (tests/test_fedavg.py)
+    drop_prob: float = 0.0
 
 
 class FedAvgSimulation:
@@ -280,6 +284,13 @@ class FedAvgSimulation:
             reuse_buffers=True,
         )
         participation = jnp.ones(len(ids), jnp.float32)
+        if self.cfg.drop_prob > 0.0:
+            from fedml_tpu.core.sampling import inject_dropout
+
+            participation = inject_dropout(
+                jax.random.PRNGKey(self.cfg.seed), round_idx, participation,
+                self.cfg.drop_prob,
+            )
         self.state, metrics = self.round_fn(
             self.state,
             jnp.asarray(pack.x),

@@ -52,3 +52,31 @@ def mask_and_ids(
     ids = sample_clients(key, round_idx, num_clients, num_per_round)
     mask = jnp.zeros(num_clients, jnp.float32).at[ids].set(1.0)
     return mask, ids
+
+
+def inject_dropout(
+    key: jax.Array, round_idx, participation: jax.Array, drop_prob: float
+) -> jax.Array:
+    """Failure injection: each participating client independently drops
+    with ``drop_prob`` (straggler/crash simulation — the failure model
+    the reference lacks entirely, SURVEY.md §5.3).
+
+    Because aggregation is a participation-masked weighted sum, a
+    dropped client's contribution is EXACTLY excluded (weight zero) —
+    the round result equals a round that never sampled it, which
+    ``tests/test_fedavg.py`` asserts.  Never drops everyone: if all
+    sampled clients would die, the first sampled one is kept (a round
+    with zero weight has no defined average).
+    """
+    k = jax.random.fold_in(jax.random.fold_in(key, round_idx), 0x0D0D)
+    survive = jax.random.bernoulli(
+        k, 1.0 - drop_prob, participation.shape
+    ).astype(participation.dtype)
+    dropped = participation * survive
+    # keep one participant alive if the draw killed them all
+    any_alive = dropped.sum() > 0
+    first_idx = jnp.argmax(participation)  # first sampled client
+    rescue = jnp.zeros_like(participation).at[first_idx].set(
+        participation[first_idx]
+    )
+    return jnp.where(any_alive, dropped, rescue)

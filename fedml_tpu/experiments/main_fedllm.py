@@ -1,0 +1,13 @@
+"""Entry shim — federated transformer fine-tuning (beyond-reference
+long-context family; ``--tp_degree N`` runs DP x TP on a (clients,
+model) device mesh)."""
+
+import sys
+
+from fedml_tpu.experiments.run import main
+
+if __name__ == "__main__":
+    main([
+        "--algorithm", "fedllm", "--dataset", "fed_shakespeare",
+        *sys.argv[1:],
+    ])

@@ -18,11 +18,15 @@ import numpy as np
 
 
 def sync_round(state: Any, metrics: Any) -> float:
-    """Full sync the tunnel can't fake: block AND read a scalar back."""
+    """Full sync the tunnel can't fake: block AND read scalars back.
+    Returns the sum over ALL metric leaves — a NaN/inf in any metric
+    (loss included) poisons the result so the caller's finiteness check
+    fires."""
     import jax
 
     jax.block_until_ready((state, metrics))
-    return float(np.sum(jax.tree_util.tree_leaves(metrics)[0]))
+    return float(sum(float(np.sum(l))
+                     for l in jax.tree_util.tree_leaves(metrics)))
 
 
 def measure_rounds(

@@ -67,3 +67,24 @@ def test_run_experiment_hierarchical_and_vfl():
     out2 = run_experiment(_ci_cfg(algorithm="vfl", comm_round=2,
                                   batch_size=64), log_fn=None)
     assert "auc" in out2["history"][-1] or "acc" in out2["history"][-1]
+
+
+def test_run_experiment_fedllm_and_dp_tp():
+    from fedml_tpu.experiments.run import ExperimentConfig, run_experiment
+
+    out = run_experiment(ExperimentConfig(
+        algorithm="fedllm", dataset="fed_shakespeare", comm_round=2,
+        client_num_in_total=4, client_num_per_round=4, batch_size=4,
+        embed_dim=32, num_heads=4, num_layers=1, lr=0.1, ci=0,
+    ), log_fn=None)
+    assert len(out["history"]) == 2
+    # DP x TP path: 2-way DP x 4-way TP over the faked 8-device mesh
+    out2 = run_experiment(ExperimentConfig(
+        algorithm="fedllm", dataset="fed_shakespeare", comm_round=2,
+        client_num_in_total=4, client_num_per_round=4, batch_size=4,
+        embed_dim=32, num_heads=4, num_layers=1, lr=0.1, tp_degree=4,
+    ), log_fn=None)
+    assert len(out2["history"]) == 2
+    assert "mesh" in out2
+    import numpy as np
+    assert np.isfinite(out2["history"][-1]["loss_sum"])

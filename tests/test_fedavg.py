@@ -156,3 +156,60 @@ def test_mixed_precision_batchnorm_state_stable():
     new_dtypes = jax.tree_util.tree_map(lambda v: v.dtype, new_vars)
     assert ref_dtypes == new_dtypes
     assert np.isfinite(float(metrics["loss_sum"]))
+
+
+def test_failure_injection_exact_exclusion():
+    """A client that drops mid-round (participation weight zeroed) is
+    EXACTLY excluded: the round result equals a round that never
+    sampled it — the elasticity property of masked-psum aggregation."""
+    from fedml_tpu.algorithms.fedavg import ServerState, make_round_fn
+    from fedml_tpu.core.client import make_client_optimizer, make_local_update
+    from fedml_tpu.core.sampling import inject_dropout
+    from fedml_tpu.core.types import pack_clients
+
+    ds = small_ds(num_clients=4)
+    bundle = logistic_regression(16, 4)
+    lu = make_local_update(bundle, make_client_optimizer("sgd", 0.1), epochs=1)
+    round_fn = jax.jit(make_round_fn(lu))
+    key = jax.random.PRNGKey(0)
+    state = ServerState(
+        variables=bundle.init(key), opt_state=(),
+        round_idx=jnp.zeros((), jnp.int32), key=key,
+    )
+    pack = pack_clients(ds, [0, 1, 2, 3], batch_size=20)
+    args = (jnp.asarray(pack.x), jnp.asarray(pack.y), jnp.asarray(pack.mask),
+            jnp.asarray(pack.num_samples))
+    ids = jnp.arange(4, dtype=jnp.int32)
+
+    # client 2 dies mid-round
+    part_dead = jnp.asarray([1.0, 1.0, 0.0, 1.0], jnp.float32)
+    s_dead, _ = round_fn(state, *args, part_dead, ids)
+    # oracle: a cohort that never contained client 2 (global slot ids
+    # keep per-client RNG streams identical across the two packings)
+    steps = pack.x.shape[1]
+    pack3 = pack_clients(ds, [0, 1, 3], batch_size=20, steps_per_epoch=steps)
+    s_never, _ = round_fn(
+        state,
+        jnp.asarray(pack3.x), jnp.asarray(pack3.y), jnp.asarray(pack3.mask),
+        jnp.asarray(pack3.num_samples),
+        jnp.ones(3, jnp.float32),
+        jnp.asarray([0, 1, 3], jnp.int32),
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a), np.asarray(b)),
+        s_dead.variables, s_never.variables,
+    )
+    # and differs from the full-cohort round
+    s_full, _ = round_fn(state, *args, jnp.ones(4, jnp.float32), ids)
+    diffs = jax.tree_util.tree_map(
+        lambda a, b: float(jnp.abs(a - b).max()), s_dead.variables,
+        s_full.variables,
+    )
+    assert max(jax.tree_util.tree_leaves(diffs)) > 0
+
+    # inject_dropout: deterministic, keeps at least one participant
+    m = inject_dropout(key, 3, jnp.ones(4, jnp.float32), drop_prob=0.5)
+    m2 = inject_dropout(key, 3, jnp.ones(4, jnp.float32), drop_prob=0.5)
+    np.testing.assert_array_equal(np.asarray(m), np.asarray(m2))
+    all_dead = inject_dropout(key, 1, jnp.ones(4, jnp.float32), drop_prob=1.0)
+    assert float(all_dead.sum()) == 1.0

@@ -24,7 +24,6 @@ builds the collective form; the test suite asserts they agree exactly.
 
 from __future__ import annotations
 
-import functools
 from typing import Callable, Dict, List, Optional
 
 import jax

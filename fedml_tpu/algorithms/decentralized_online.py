@@ -21,7 +21,7 @@ jit-compiled end-to-end so a million-step stream is one device program.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Tuple
+from typing import Tuple
 
 import jax
 import jax.numpy as jnp

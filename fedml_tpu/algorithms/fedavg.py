@@ -32,7 +32,7 @@ import numpy as np
 from fedml_tpu.core import tree as treelib
 from fedml_tpu.core.client import LocalUpdateFn, make_client_optimizer, make_evaluator, make_local_update
 from fedml_tpu.core.losses import LossFn, masked_softmax_ce
-from fedml_tpu.core.types import ClientBatches, FedDataset, batch_eval_pack, pack_clients
+from fedml_tpu.core.types import FedDataset, batch_eval_pack, pack_clients
 from fedml_tpu.models.base import ModelBundle
 
 PyTree = Any

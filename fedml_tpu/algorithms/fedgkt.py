@@ -22,7 +22,7 @@ sharded residents of the ``clients`` axis.
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+from typing import Any, Optional
 
 import jax
 import jax.numpy as jnp

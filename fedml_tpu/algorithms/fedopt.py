@@ -17,9 +17,8 @@ parameters while the averaged state_dict supplies buffers.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Tuple
+from typing import Any
 
-import jax
 import optax
 
 from fedml_tpu.algorithms.fedavg import (

@@ -16,7 +16,7 @@ note lives in ``fedml_tpu.parallel.spmd``.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp

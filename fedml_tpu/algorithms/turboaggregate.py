@@ -19,7 +19,7 @@ element), tested in ``tests/test_turboaggregate.py``.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Optional, Sequence, Tuple
+from typing import List, Sequence
 
 import jax
 import jax.numpy as jnp

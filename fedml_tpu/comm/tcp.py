@@ -22,7 +22,7 @@ import json
 import logging
 import socket
 import threading
-from typing import Dict, Optional
+from typing import Dict
 
 from fedml_tpu.comm.backend import CommBackend
 from fedml_tpu.comm.message import Message

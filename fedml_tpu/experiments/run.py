@@ -139,9 +139,10 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
             batch_size=cfg.batch_size, client_optimizer=cfg.client_optimizer,
             lr=cfg.lr, momentum=cfg.momentum, weight_decay=cfg.wd,
             frequency_of_the_test=cfg.frequency_of_the_test, seed=cfg.seed,
+            compute_dtype=cfg.compute_dtype or None, drop_prob=cfg.drop_prob,
         ))
+        # run() already merges evaluate_global() into the final round
         hist = sim.run(log_fn=log_fn)
-        hist[-1].update(sim.evaluate_global())
         return {"history": hist, "final": hist[-1],
                 "wall_s": time.time() - t0}
 
@@ -160,11 +161,16 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
     if K % dp:
         raise ValueError(f"cohort {K} not divisible by dp width {dp}")
     mesh = make_dp_tp_mesh(dp, cfg.tp_degree)
+    from fedml_tpu.algorithms.fedavg import resolve_compute_dtype
+
     opt = make_client_optimizer(
         cfg.client_optimizer, cfg.lr, momentum=cfg.momentum,
         weight_decay=cfg.wd,
     )
-    lu = make_local_update(bundle, opt, epochs=cfg.epochs)
+    lu = make_local_update(
+        bundle, opt, epochs=cfg.epochs,
+        compute_dtype=resolve_compute_dtype(cfg.compute_dtype or None),
+    )
     key = jax.random.PRNGKey(cfg.seed)
     state = ServerState(
         variables=bundle.init(key), opt_state=(),
@@ -174,18 +180,30 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
         mesh, lu, state.variables
     )
     state = shard_state(state)
-    rng = np.random.RandomState(cfg.seed)
     hist = []
     counts = ds.client_sample_counts()
     steps = max(1, int(np.ceil(max(int(counts.max()), 1) / cfg.batch_size)))
     for r in range(cfg.comm_round):
-        ids = (np.sort(rng.choice(ds.num_clients, K, replace=False))
-               if K < ds.num_clients else np.arange(K))
+        # SAME per-round sampling stream as FedAvgSimulation._sample_ids,
+        # so tp_degree=1 and tp_degree>1 runs are cohort-comparable
+        if K < ds.num_clients:
+            rr = np.random.RandomState(cfg.seed * 100003 + r)
+            ids = np.sort(rr.choice(ds.num_clients, K, replace=False))
+        else:
+            ids = np.arange(K)
         pack = pack_clients(ds, ids, cfg.batch_size, steps_per_epoch=steps,
                             seed=cfg.seed + r, reuse_buffers=True)
+        participation = np.ones(K, np.float32)
+        if cfg.drop_prob > 0.0:
+            from fedml_tpu.core.sampling import inject_dropout
+
+            participation = np.asarray(inject_dropout(
+                jax.random.PRNGKey(cfg.seed), r,
+                jnp.asarray(participation), cfg.drop_prob,
+            ))
         state, m = round_fn(state, *shard_data((
             pack.x, pack.y, pack.mask, pack.num_samples,
-            np.ones(K, np.float32), np.asarray(ids, np.int32),
+            participation, np.asarray(ids, np.int32),
         )))
         row = {"round": r, **{k: float(v) for k, v in m.items()}}
         if row.get("count"):

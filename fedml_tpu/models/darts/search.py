@@ -19,7 +19,7 @@ data-dependent control flow, which doesn't jit).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Sequence, Tuple
+from typing import Any, Sequence
 
 import flax.linen as nn
 import jax

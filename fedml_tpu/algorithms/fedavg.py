@@ -262,12 +262,11 @@ class FedAvgSimulation:
         return {}
 
     def _sample_ids(self, round_idx: int) -> np.ndarray:
-        cfg = self.cfg
-        if cfg.clients_per_round >= cfg.num_clients:
-            return np.arange(cfg.num_clients)
-        rng = np.random.RandomState(cfg.seed * 100003 + round_idx)
-        return np.sort(
-            rng.choice(cfg.num_clients, cfg.clients_per_round, replace=False)
+        from fedml_tpu.core.sampling import host_sample_ids
+
+        return host_sample_ids(
+            self.cfg.seed, round_idx, self.cfg.num_clients,
+            self.cfg.clients_per_round,
         )
 
     def run_round(self) -> dict:

@@ -32,6 +32,36 @@ from fedml_tpu.models.base import ModelBundle
 PyTree = Any
 
 
+def _scale_by_amsgrad_torch(
+    b1: float = 0.9, b2: float = 0.999, eps: float = 1e-8
+) -> optax.GradientTransformation:
+    """torch.optim.Adam(amsgrad=True) semantics exactly: the running max
+    is over the RAW second moment, and bias correction divides the max
+    (optax.amsgrad maxes the bias-corrected nu instead, which diverges
+    from torch over the first steps — verified numerically)."""
+
+    def init(params):
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        return {"count": jnp.zeros((), jnp.int32), "mu": zeros,
+                "nu": zeros, "nu_max": zeros}
+
+    def update(updates, state, params=None):
+        del params
+        t = state["count"] + 1
+        mu = jax.tree_util.tree_map(
+            lambda m, g: b1 * m + (1 - b1) * g, state["mu"], updates)
+        nu = jax.tree_util.tree_map(
+            lambda v, g: b2 * v + (1 - b2) * g * g, state["nu"], updates)
+        nu_max = jax.tree_util.tree_map(jnp.maximum, state["nu_max"], nu)
+        c1 = 1 - b1 ** t.astype(jnp.float32)
+        c2 = 1 - b2 ** t.astype(jnp.float32)
+        out = jax.tree_util.tree_map(
+            lambda m, v: (m / c1) / (jnp.sqrt(v / c2) + eps), mu, nu_max)
+        return out, {"count": t, "mu": mu, "nu": nu, "nu_max": nu_max}
+
+    return optax.GradientTransformation(init, update)
+
+
 def make_client_optimizer(
     name: str = "sgd",
     lr: float = 0.03,
@@ -50,10 +80,14 @@ def make_client_optimizer(
             chain.append(optax.add_decayed_weights(weight_decay))
         chain.append(optax.sgd(lr, momentum=momentum if momentum else None))
     elif name == "adam":
-        # reference default: Adam(lr, wd=0.0001, amsgrad=True)
-        chain.append(
-            optax.adamw(lr, weight_decay=weight_decay or 1e-4, nesterov=False)
-        )
+        # reference default: torch.optim.Adam(lr, weight_decay=0.0001,
+        # amsgrad=True) (MyModelTrainer.py:38-40).  torch's weight_decay
+        # is COUPLED L2 (wd*p added to the gradient before the adam
+        # update), so add_decayed_weights goes BEFORE the scaling — not
+        # decoupled adamw
+        chain.append(optax.add_decayed_weights(weight_decay or 1e-4))
+        chain.append(_scale_by_amsgrad_torch())
+        chain.append(optax.scale(-lr))
     else:
         raise ValueError(f"unknown client optimizer: {name}")
     return optax.chain(*chain)

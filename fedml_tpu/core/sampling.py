@@ -54,6 +54,20 @@ def mask_and_ids(
     return mask, ids
 
 
+def host_sample_ids(
+    seed: int, round_idx: int, num_clients: int, num_per_round: int
+):
+    """Host-side (numpy) per-round cohort sampling — the single source
+    of truth for every round driver (simulation, DP×TP loop), so runs
+    with the same seed are cohort-comparable across execution modes."""
+    import numpy as np
+
+    if num_per_round >= num_clients:
+        return np.arange(num_clients)
+    rng = np.random.RandomState(seed * 100003 + round_idx)
+    return np.sort(rng.choice(num_clients, num_per_round, replace=False))
+
+
 def inject_dropout(
     key: jax.Array, round_idx, participation: jax.Array, drop_prob: float
 ) -> jax.Array:

@@ -183,14 +183,11 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
     hist = []
     counts = ds.client_sample_counts()
     steps = max(1, int(np.ceil(max(int(counts.max()), 1) / cfg.batch_size)))
+    from fedml_tpu.core.sampling import host_sample_ids
+
     for r in range(cfg.comm_round):
-        # SAME per-round sampling stream as FedAvgSimulation._sample_ids,
-        # so tp_degree=1 and tp_degree>1 runs are cohort-comparable
-        if K < ds.num_clients:
-            rr = np.random.RandomState(cfg.seed * 100003 + r)
-            ids = np.sort(rr.choice(ds.num_clients, K, replace=False))
-        else:
-            ids = np.arange(K)
+        # shared sampler: tp_degree=1 and >1 runs are cohort-comparable
+        ids = host_sample_ids(cfg.seed, r, ds.num_clients, K)
         pack = pack_clients(ds, ids, cfg.batch_size, steps_per_epoch=steps,
                             seed=cfg.seed + r, reuse_buffers=True)
         participation = np.ones(K, np.float32)
@@ -411,8 +408,8 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         raise ValueError(f"unknown algorithm: {cfg.algorithm}")
 
     hist = sim.run(log_fn=log_fn)
-    final = {**hist[-1], **sim.evaluate_global()}
-    return {"history": hist, "final": final, "wall_s": time.time() - t0}
+    # run() merges evaluate_global() into the final round already
+    return {"history": hist, "final": hist[-1], "wall_s": time.time() - t0}
 
 
 def main(argv=None):

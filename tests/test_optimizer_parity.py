@@ -1,0 +1,61 @@
+"""Client-optimizer parity against the reference's torch semantics.
+
+The reference's client Adam is ``torch.optim.Adam(lr, weight_decay=1e-4,
+amsgrad=True)`` (``MyModelTrainer.py:38-40``) — COUPLED L2 weight decay
+and the torch amsgrad variant (running max over the RAW second moment).
+Both differ subtly from optax's adamw/amsgrad; rounds-to-accuracy parity
+depends on getting them right, so we pin them against torch itself."""
+
+import numpy as np
+import optax
+import pytest
+import torch
+
+import jax
+import jax.numpy as jnp
+
+from fedml_tpu.core.client import make_client_optimizer
+
+
+def _run_pair(name, lr, torch_factory, steps=8, **kw):
+    rng = np.random.RandomState(0)
+    w0 = rng.randn(6, 4).astype(np.float32)
+    grads = [rng.randn(6, 4).astype(np.float32) for _ in range(steps)]
+
+    wt = torch.nn.Parameter(torch.tensor(w0.copy()))
+    opt_t = torch_factory([wt])
+    for g in grads:
+        opt_t.zero_grad()
+        wt.grad = torch.tensor(g)
+        opt_t.step()
+
+    opt_j = make_client_optimizer(name, lr, **kw)
+    state = opt_j.init(jnp.asarray(w0))
+    wj = jnp.asarray(w0)
+    for g in grads:
+        upd, state = opt_j.update(jnp.asarray(g), state, wj)
+        wj = optax.apply_updates(wj, upd)
+    np.testing.assert_allclose(
+        np.asarray(wj), wt.detach().numpy(), rtol=1e-5, atol=1e-6
+    )
+
+
+def test_adam_matches_torch_amsgrad_coupled_l2():
+    _run_pair(
+        "adam", 0.01,
+        lambda ps: torch.optim.Adam(ps, lr=0.01, weight_decay=1e-4,
+                                    amsgrad=True),
+    )
+
+
+def test_sgd_momentum_wd_matches_torch():
+    _run_pair(
+        "sgd", 0.1,
+        lambda ps: torch.optim.SGD(ps, lr=0.1, momentum=0.9,
+                                   weight_decay=1e-3),
+        momentum=0.9, weight_decay=1e-3,
+    )
+
+
+def test_plain_sgd_matches_torch():
+    _run_pair("sgd", 0.05, lambda ps: torch.optim.SGD(ps, lr=0.05))

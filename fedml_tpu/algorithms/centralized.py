@@ -33,7 +33,7 @@ class CentralizedTrainer:
         optimizer: str = "sgd",
         lr: float = 0.03,
         momentum: float = 0.0,
-        weight_decay: float = 0.0,
+        weight_decay: Optional[float] = None,
         grad_clip: Optional[float] = None,
         loss_fn: LossFn = masked_softmax_ce,
         seed: int = 0,

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from fedml_tpu.core import tree as treelib
-from fedml_tpu.core.client import LocalUpdateFn, make_client_optimizer, make_evaluator, make_local_update
+from fedml_tpu.core.client import LocalUpdateFn, eval_summary, make_client_optimizer, make_evaluator, make_local_update
 from fedml_tpu.core.losses import LossFn, masked_softmax_ce
 from fedml_tpu.core.types import FedDataset, batch_eval_pack, pack_clients
 from fedml_tpu.models.base import ModelBundle
@@ -168,7 +168,9 @@ class FedAvgConfig:
     client_optimizer: str = "sgd"
     lr: float = 0.03
     momentum: float = 0.0
-    weight_decay: float = 0.0
+    # None = optimizer default (0 for sgd, the reference's 1e-4 torch
+    # Adam default); an explicit 0.0 is honored as zero decay
+    weight_decay: Optional[float] = None
     grad_clip: Optional[float] = None
     frequency_of_the_test: int = 5
     seed: int = 0
@@ -311,21 +313,19 @@ class FedAvgSimulation:
         res = self.evaluator(
             self.state.variables, jnp.asarray(x), jnp.asarray(y), jnp.asarray(m)
         )
-        count = float(res["count"])
-        return {
-            "test_acc": float(res["correct"]) / max(count, 1.0),
-            "test_loss": float(res["loss_sum"]) / max(count, 1.0),
-            "test_count": count,
-        }
+        return eval_summary(res)
 
     def run(self, rounds: Optional[int] = None, log_fn=None) -> list:
         rounds = rounds if rounds is not None else self.cfg.comm_rounds
-        for _ in range(rounds):
+        for i in range(rounds):
             metrics = self.run_round()
             r = metrics["round"]
+            # final-round eval keys on THIS call's last iteration, not the
+            # absolute round index, so run(rounds=N) and resumed runs also
+            # end with test metrics in their last history row
             if (
                 r % self.cfg.frequency_of_the_test == 0
-                or r == self.cfg.comm_rounds - 1
+                or i == rounds - 1
             ):
                 metrics.update(self.evaluate_global())
                 metrics.update(self._extra_eval())

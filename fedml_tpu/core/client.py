@@ -67,11 +67,16 @@ def make_client_optimizer(
     lr: float = 0.03,
     *,
     momentum: float = 0.0,
-    weight_decay: float = 0.0,
+    weight_decay: Optional[float] = None,
     grad_clip: Optional[float] = None,
 ) -> optax.GradientTransformation:
     """The reference's client optimizers: SGD (+momentum/wd) or amsgrad Adam
-    (``MyModelTrainer.py:33-41``)."""
+    (``MyModelTrainer.py:33-41``).
+
+    ``weight_decay=None`` means "optimizer default" (0 for sgd, the
+    reference's 1e-4 for adam); an explicit 0.0 is honored as zero so
+    wd=0 runs are reproducible.
+    """
     chain = []
     if grad_clip is not None:
         chain.append(optax.clip_by_global_norm(grad_clip))
@@ -85,7 +90,9 @@ def make_client_optimizer(
         # is COUPLED L2 (wd*p added to the gradient before the adam
         # update), so add_decayed_weights goes BEFORE the scaling — not
         # decoupled adamw
-        chain.append(optax.add_decayed_weights(weight_decay or 1e-4))
+        wd = 1e-4 if weight_decay is None else weight_decay
+        if wd:
+            chain.append(optax.add_decayed_weights(wd))
         chain.append(_scale_by_amsgrad_torch())
         chain.append(optax.scale(-lr))
     else:
@@ -235,3 +242,15 @@ def make_evaluator(bundle: ModelBundle, loss_fn: LossFn = masked_softmax_ce):
         return {k: v.sum() for k, v in auxs.items()}
 
     return jax.jit(evaluate)
+
+
+def eval_summary(res) -> dict:
+    """Summed evaluator metrics → the test_{acc,loss,count} record every
+    driver reports (shared so the simulation and DP×TP paths can't
+    drift apart)."""
+    count = float(res["count"])
+    return {
+        "test_acc": float(res["correct"]) / max(count, 1.0),
+        "test_loss": float(res["loss_sum"]) / max(count, 1.0),
+        "test_count": count,
+    }

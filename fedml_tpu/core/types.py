@@ -22,6 +22,7 @@ samples out of losses and counts).
 from __future__ import annotations
 
 import dataclasses
+import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -130,20 +131,26 @@ class ClientBatches:
 # reusable gather targets for fixed-geometry round loops; one buffer per
 # role tag, replaced when the requested geometry changes — bounded at
 # (number of tags) live buffers no matter how many shapes a sweep visits
-_pack_buffer_cache: dict = {}
+_pack_buffer_cache = threading.local()
 
 
 def _gather_target(tag: str, shape, dtype, reuse: bool):
     if not reuse:
         return None
+    # Thread-local cache: two threads packing concurrently get distinct
+    # buffers, so the consume-before-repack contract (pack_clients
+    # docstring) only has to hold within one thread.
+    cache = getattr(_pack_buffer_cache, "bufs", None)
+    if cache is None:
+        cache = _pack_buffer_cache.bufs = {}
     # tag keeps roles distinct: x and y packs with identical shape+dtype
     # must not share one buffer
     shape = tuple(shape)
     dtype = np.dtype(dtype)
-    buf = _pack_buffer_cache.get(tag)
+    buf = cache.get(tag)
     if buf is None or buf.shape != shape or buf.dtype != dtype:
         buf = np.empty(shape, dtype)
-        _pack_buffer_cache[tag] = buf
+        cache[tag] = buf
     return buf
 
 

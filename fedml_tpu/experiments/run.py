@@ -185,6 +185,20 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
     steps = max(1, int(np.ceil(max(int(counts.max()), 1) / cfg.batch_size)))
     from fedml_tpu.core.sampling import host_sample_ids
 
+    # same evaluator + cadence as the tp_degree==1 simulation driver, so
+    # the two paths stay comparable (jit runs the fp32 eval forward with
+    # the TP-sharded variables in place — no gather needed)
+    from fedml_tpu.core.client import eval_summary, make_evaluator
+    from fedml_tpu.core.types import batch_eval_pack
+
+    evaluator = make_evaluator(bundle)
+    tx, ty, tm = batch_eval_pack(ds.test_x, ds.test_y, max(cfg.batch_size, 64))
+
+    def eval_global(variables):
+        return eval_summary(evaluator(
+            variables, jnp.asarray(tx), jnp.asarray(ty), jnp.asarray(tm)
+        ))
+
     for r in range(cfg.comm_round):
         # shared sampler: tp_degree=1 and >1 runs are cohort-comparable
         ids = host_sample_ids(cfg.seed, r, ds.num_clients, K)
@@ -205,6 +219,8 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
         row = {"round": r, **{k: float(v) for k, v in m.items()}}
         if row.get("count"):
             row["train_loss"] = row["loss_sum"] / row["count"]
+        if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
+            row.update(eval_global(state.variables))
         hist.append(row)
         if log_fn:
             log_fn(row)

@@ -88,3 +88,8 @@ def test_run_experiment_fedllm_and_dp_tp():
     assert "mesh" in out2
     import numpy as np
     assert np.isfinite(out2["history"][-1]["loss_sum"])
+    # the tp path evaluates like the tp_degree==1 driver: both finals
+    # carry comparable test metrics
+    assert np.isfinite(out["final"]["test_acc"])
+    assert np.isfinite(out2["final"]["test_acc"])
+    assert np.isfinite(out2["final"]["test_loss"])

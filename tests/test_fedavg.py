@@ -38,6 +38,24 @@ def test_fedavg_learns():
     assert last["test_acc"] > max(first["test_acc"] + 0.2, 0.6)
 
 
+def test_partial_run_final_row_has_test_metrics():
+    """run(rounds=N) with N != comm_rounds must still end with test
+    metrics in its last history row (ADVICE r1: final-round eval keys on
+    the loop position, not the absolute round index)."""
+    ds = small_ds()
+    bundle = logistic_regression(16, 4)
+    cfg = FedAvgConfig(
+        num_clients=4, clients_per_round=4, comm_rounds=10, epochs=1,
+        batch_size=20, lr=0.1, frequency_of_the_test=7,
+    )
+    sim = FedAvgSimulation(bundle, ds, cfg)
+    hist = sim.run(rounds=2)  # round 1: 1 % 7 != 0 and != comm_rounds-1
+    assert "test_acc" in hist[-1]
+    # resumed second leg ends with test metrics too
+    hist2 = sim.run(rounds=2)
+    assert "test_acc" in hist2[-1]
+
+
 def test_fedavg_subsampling_runs():
     ds = small_ds(num_clients=8)
     bundle = logistic_regression(16, 4)

@@ -59,3 +59,14 @@ def test_sgd_momentum_wd_matches_torch():
 
 def test_plain_sgd_matches_torch():
     _run_pair("sgd", 0.05, lambda ps: torch.optim.SGD(ps, lr=0.05))
+
+
+def test_adam_explicit_wd_zero_honored():
+    """weight_decay=0.0 must mean ZERO decay (ADVICE r1): only None falls
+    back to the reference's 1e-4 torch default."""
+    _run_pair(
+        "adam", 0.01,
+        lambda ps: torch.optim.Adam(ps, lr=0.01, weight_decay=0.0,
+                                    amsgrad=True),
+        weight_decay=0.0,
+    )

@@ -158,6 +158,84 @@ def make_round_fn(
     return round_fn
 
 
+def make_multi_round_fn(
+    local_update: LocalUpdateFn,
+    rounds_per_call: int,
+    *,
+    clients_per_round: Optional[int] = None,
+    drop_prob: float = 0.0,
+    **round_kw,
+):
+    """Fuse ``rounds_per_call`` federated rounds into ONE compiled
+    program: a ``lax.scan`` over the round kernel with zero host syncs
+    in between (SURVEY.md §7 "avoid per-round host sync except
+    metrics").
+
+    This is the cross-silo resident-cohort execution mode — the
+    BASELINE north-star regime (all clients' packed shards stay on
+    device across rounds).  Per-round cohort subsampling and failure
+    injection move on-device: ``clients_per_round`` re-draws a seeded
+    uniform participation mask from the server key each round
+    (``core/sampling.py`` semantics), and ``drop_prob`` composes
+    ``inject_dropout`` on top.  Because the round kernel derives all
+    randomness from ``fold_in(state.key, state.round_idx)``, R fused
+    rounds produce bit-identical results to R sequential
+    ``make_round_fn`` calls (pinned by
+    ``tests/test_fedavg.py::test_multi_round_fused_matches_sequential``).
+
+    Measured on one v5e chip this removes the ~40% device-idle gaps a
+    per-round dispatch+readback loop spends on the host round-trip
+    (PROFILE.md).  Returns ``(final_state, metrics)`` with each metric
+    stacked ``[rounds_per_call, ...]``.
+
+    Note: on-device subsampling and dropout need the FULL client axis in
+    view, so ``clients_per_round``/``drop_prob`` are for the
+    single-program path; under shard_map (``axis_name`` set) each device
+    only sees its local block — a global exactly-K draw would need a
+    collective, and the replicated key would stamp identical drop
+    patterns on every device's block — so pass per-round masks from the
+    host there instead.
+    """
+    from fedml_tpu.core.sampling import (
+        eligible_participation_mask,
+        inject_dropout,
+    )
+
+    if round_kw.get("axis_name") and (
+        clients_per_round is not None or drop_prob
+    ):
+        raise ValueError(
+            "on-device clients_per_round/drop_prob are not defined under "
+            "shard_map (local block != global client axis)"
+        )
+    rf = make_round_fn(local_update, **round_kw)
+
+    def multi_round_fn(
+        state: ServerState, x, y, mask, num_samples, participation, slot_ids
+    ):
+        num_clients = participation.shape[0]
+
+        def body(st, _):
+            part = participation
+            if (
+                clients_per_round is not None
+                and clients_per_round < num_clients
+            ):
+                # eligibility-aware draw: samples only among the caller's
+                # participation>0 clients, never yielding an empty cohort
+                # (which would zero the weighted average)
+                part = eligible_participation_mask(
+                    st.key, st.round_idx, participation, clients_per_round
+                )
+            if drop_prob:
+                part = inject_dropout(st.key, st.round_idx, part, drop_prob)
+            return rf(st, x, y, mask, num_samples, part, slot_ids)
+
+        return jax.lax.scan(body, state, None, length=rounds_per_call)
+
+    return multi_round_fn
+
+
 @dataclasses.dataclass
 class FedAvgConfig:
     num_clients: int = 10

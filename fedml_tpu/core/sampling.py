@@ -54,6 +54,29 @@ def mask_and_ids(
     return mask, ids
 
 
+def eligible_participation_mask(
+    key: jax.Array, round_idx, participation: jax.Array, num_per_round: int
+) -> jax.Array:
+    """Seeded uniform draw of ``min(num_per_round, #eligible)`` distinct
+    clients among ``participation > 0``, returned as a mask.
+
+    Top-K over iid uniform scores is a uniform K-subset, so for a fully
+    eligible cohort this has the same distribution as
+    ``participation_mask``; unlike intersecting an unconditional draw
+    with the eligibility mask, it can never come up empty while any
+    client is eligible (an empty cohort would make the round's weighted
+    average undefined and zero the global model).
+    """
+    k = jax.random.fold_in(jax.random.fold_in(key, round_idx), 0x5A11)
+    num_per_round = min(int(num_per_round), int(participation.shape[0]))
+    scores = jax.random.uniform(k, participation.shape)
+    scores = jnp.where(participation > 0, scores, -1.0)
+    _, idx = jax.lax.top_k(scores, num_per_round)
+    mask = jnp.zeros_like(participation).at[idx].set(1.0)
+    # ineligible slots can only be picked when eligible < K; strip them
+    return mask * (participation > 0)
+
+
 def host_sample_ids(
     seed: int, round_idx: int, num_clients: int, num_per_round: int
 ):

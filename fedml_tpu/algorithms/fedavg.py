@@ -201,6 +201,11 @@ def make_multi_round_fn(
         inject_dropout,
     )
 
+    if clients_per_round is not None and clients_per_round < 1:
+        raise ValueError(
+            f"clients_per_round must be >= 1, got {clients_per_round} "
+            "(0 would zero every round's weighted average)"
+        )
     if round_kw.get("axis_name") and (
         clients_per_round is not None or drop_prob
     ):

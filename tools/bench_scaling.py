@@ -17,7 +17,13 @@ Two modes, one JSON line per measured point:
   clients (sequential lax.map, full MXU tiles each).
 
 Timing per point follows bench.py: warm until two consecutive
-fully-synced rounds agree, then median of synced per-round times.
+fully-synced calls agree, then median of synced per-call times.  In
+chips mode one call == one round (dispatch-inclusive).  In clients mode
+one call == ``--rounds-per-call`` rounds fused by ``make_multi_round_fn``
+and ``s_per_round`` = call time / rounds_per_call — the per-dispatch
+tunnel round-trip is deliberately amortized out (PROFILE.md measured it
+at ~40% of per-round wall-clock), so the points report compute scaling;
+pass ``--rounds-per-call 1`` for dispatch-inclusive points.
 """
 
 from __future__ import annotations
@@ -60,6 +66,12 @@ def main():
     p.add_argument("--steps", type=int, default=8)
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--rounds", type=int, default=5)
+    p.add_argument(
+        "--rounds-per-call", type=int, default=5,
+        help="clients mode: rounds fused per compiled call "
+        "(make_multi_round_fn) so the point measures compute scaling, "
+        "not per-dispatch tunnel latency (PROFILE.md)",
+    )
     p.add_argument("--model", default="resnet20",
                    help="resnet20 (cpu-friendly) or resnet56")
     args = p.parse_args()
@@ -77,7 +89,6 @@ def main():
 
     from fedml_tpu.algorithms.fedavg import (
         ServerState,
-        make_round_fn,
         resolve_compute_dtype,
     )
     from fedml_tpu.core.client import make_client_optimizer, make_local_update
@@ -136,7 +147,10 @@ def main():
                 )
             results.append(point)
     else:
-        rf = jax.jit(make_round_fn(local_update))
+        from fedml_tpu.algorithms.fedavg import make_multi_round_fn
+
+        rpc = args.rounds_per_call
+        rf = jax.jit(make_multi_round_fn(local_update, rpc))
         for C in (1, 2, 4, 8, 16):
             inputs = tuple(
                 jnp.asarray(a)
@@ -145,8 +159,9 @@ def main():
             t, _ = _measure(rf, fresh_state(), inputs, args.rounds)
             results.append({
                 "metric": "clients_per_chip_throughput",
-                "clients": C, "value": round(C * S * B / t, 1),
-                "unit": "samples/sec", "s_per_round": round(t, 4),
+                "clients": C, "value": round(C * S * B * rpc / t, 1),
+                "unit": "samples/sec", "s_per_round": round(t / rpc, 4),
+                "rounds_per_call": rpc,
             })
 
     for r in results:

@@ -2,8 +2,10 @@
 
 Reference: ``fedml_api/data_preprocessing/MNIST/data_loader.py:8-123``
 reads LEAF's pre-partitioned power-law JSON (1000 users).  Here the
-loader reads raw MNIST IDX or .npz files from ``data_dir`` when present
-and partitions with the power-law partitioner
+loader reads, in order of preference: LEAF ``train/``+``test/`` JSON
+directories (the reference's format — users become the natural client
+partition), raw MNIST IDX, or ``mnist.npz``, partitioning the raw
+formats with the power-law partitioner
 (``fedml_tpu.core.partition.powerlaw_partition``); with no files on disk
 (this environment has no egress) it falls back to a matched-shape
 synthetic stand-in so every pipeline stays runnable end-to-end.
@@ -12,6 +14,7 @@ synthetic stand-in so every pipeline stays runnable end-to-end.
 from __future__ import annotations
 
 import gzip
+import json
 import os
 import struct
 from typing import Optional
@@ -39,6 +42,55 @@ def _find(data_dir: str, names) -> Optional[str]:
     return None
 
 
+def _leaf_json_dir(d: str):
+    if not os.path.isdir(d):
+        return None
+    files = sorted(f for f in os.listdir(d) if f.endswith(".json"))
+    return [os.path.join(d, f) for f in files] or None
+
+
+def _read_leaf_users(paths):
+    """LEAF JSON: {"users": [...], "user_data": {u: {"x": [[784 floats
+    in 0..1]], "y": [labels]}}} (reference MNIST/data_loader.py:8-43).
+    Returns {user_id: (x, y)} in file-then-user order."""
+    users = {}
+    for p in paths:
+        with open(p) as f:
+            data = json.load(f)
+        for user in data["users"]:
+            ud = data["user_data"][user]
+            users[user] = (
+                np.asarray(ud["x"], np.float32),
+                np.asarray(ud["y"], np.int32),
+            )
+    if not users:
+        raise ValueError("no users in LEAF files")
+    return users
+
+
+def _stack_leaf(users, order, flatten: bool):
+    """Concatenate the given users' shards in ``order``; users absent
+    from ``users`` get an empty index set so train/test client slots
+    always refer to the SAME user id."""
+    xs, ys, idx = [], [], {}
+    off = 0
+    for c, user in enumerate(order):
+        if user not in users:
+            idx[c] = np.arange(0)
+            continue
+        ux, uy = users[user]
+        if not flatten:
+            ux = ux.reshape(len(uy), 28, 28, 1)
+        xs.append(ux)
+        ys.append(uy)
+        idx[c] = np.arange(off, off + len(uy))
+        off += len(uy)
+    shape = (0, 784) if flatten else (0, 28, 28, 1)
+    x = np.concatenate(xs) if xs else np.zeros(shape, np.float32)
+    y = np.concatenate(ys) if ys else np.zeros((0,), np.int32)
+    return x, y, idx
+
+
 def load_mnist(
     data_dir: str = "./data/mnist",
     num_clients: int = 1000,
@@ -47,6 +99,29 @@ def load_mnist(
     flatten: bool = True,
     seed: int = 0,
 ) -> FedDataset:
+    leaf_tr = _leaf_json_dir(os.path.join(data_dir, "train"))
+    leaf_te = _leaf_json_dir(os.path.join(data_dir, "test"))
+    if leaf_tr and leaf_te:
+        try:
+            tr_users = _read_leaf_users(leaf_tr)
+            te_users = _read_leaf_users(leaf_te)
+        except (KeyError, ValueError, json.JSONDecodeError):
+            # not actually LEAF-format json — fall through to IDX/npz
+            pass
+        else:
+            # client slots keyed by TRAIN user order; the test split is
+            # matched by user id (a user with no test file entry gets an
+            # empty test partition, never another user's data)
+            order = list(tr_users.keys())
+            train_x, train_y, train_idx = _stack_leaf(tr_users, order, flatten)
+            test_x, test_y, test_idx = _stack_leaf(te_users, order, flatten)
+            return FedDataset(
+                train_x=train_x, train_y=train_y,
+                test_x=test_x, test_y=test_y,
+                train_client_idx=train_idx, test_client_idx=test_idx,
+                num_classes=10, name="mnist",
+            )
+
     tr_x = _find(data_dir, ["train-images-idx3-ubyte", "train-images.idx3-ubyte"])
     tr_y = _find(data_dir, ["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"])
     te_x = _find(data_dir, ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"])

@@ -39,6 +39,8 @@ def load_uci_stream(
     if os.path.exists(path):
         x, y = _read_csv(path, label_col=0)
         y = (y > 0).astype(np.int32)
+        # small real files: shrink the holdout so the split stays valid
+        holdout = min(64, max(1, len(x) // 5))
     else:
         rng = np.random.RandomState(seed)
         dim = 18 if name.upper() == "SUSY" else 5
@@ -47,7 +49,9 @@ def load_uci_stream(
         x = rng.randn(n, dim).astype(np.float32)
         y = (x @ w + 0.3 * rng.randn(n) > 0).astype(np.int32)
         name = f"{name}(synthetic-standin)"
-    n_train = len(x) - 64
+        holdout = 64  # n was sized for exactly this, keeping
+        # samples_per_client contractual on the synthetic path
+    n_train = len(x) - holdout
     per = n_train // num_clients
     idx = {c: np.arange(c * per, (c + 1) * per) for c in range(num_clients)}
     return FedDataset(

@@ -1,0 +1,453 @@
+"""Real-format parser tests (VERDICT r1 #4): every loader's real-artifact
+path is exercised against a minimal fixture file written into tmp_path —
+no loader's only tested path is the synthetic fallback anymore.
+
+Formats mirror the reference's artifacts:
+- MNIST: LEAF per-user JSON (MNIST/data_loader.py:8-123), raw IDX, npz
+- CIFAR-10/100: python pickle batches (cifar10/data_loader.py:235-269)
+- FEMNIST / fed_CIFAR100: TFF h5 examples/<cid>/{pixels|image,label}
+- Shakespeare: LEAF all_data json; fed_shakespeare: TFF h5 snippets
+- StackOverflow NWP: h5 examples/<cid>/tokens; LR: x/y/client_ptr h5
+- ImageNet/Landmarks: preprocessed npz with user_train natural split
+- UCI: CSV stream; lending-club / NUS-WIDE: processed npz
+"""
+
+import gzip
+import json
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+
+# ---------- MNIST ----------
+
+def _write_idx(path, arr, gz=False):
+    arr = np.asarray(arr, np.uint8)
+    header = struct.pack(">HBB", 0, 8, arr.ndim) + struct.pack(
+        ">" + "I" * arr.ndim, *arr.shape
+    )
+    path = str(path) + ".gz" if gz else str(path)
+    opener = gzip.open if gz else open
+    with opener(path, "wb") as f:
+        f.write(header + arr.tobytes())
+
+
+def test_mnist_idx(tmp_path):
+    from fedml_tpu.data.mnist import load_mnist
+
+    rng = np.random.RandomState(0)
+    tr_img = rng.randint(0, 256, (40, 28, 28))
+    tr_lab = rng.randint(0, 10, (40,))
+    te_img = rng.randint(0, 256, (8, 28, 28))
+    te_lab = rng.randint(0, 10, (8,))
+    _write_idx(tmp_path / "train-images-idx3-ubyte", tr_img, gz=True)
+    _write_idx(tmp_path / "train-labels-idx1-ubyte", tr_lab)
+    _write_idx(tmp_path / "t10k-images-idx3-ubyte", te_img)
+    _write_idx(tmp_path / "t10k-labels-idx1-ubyte", te_lab)
+
+    ds = load_mnist(str(tmp_path), num_clients=4, flatten=True)
+    assert ds.name == "mnist"
+    assert ds.train_x.shape == (40, 784)
+    assert ds.test_x.shape == (8, 784)
+    np.testing.assert_allclose(
+        ds.train_x[0], tr_img.reshape(40, -1)[0] / 255.0, atol=1e-6
+    )
+    np.testing.assert_array_equal(ds.train_y, tr_lab)
+    covered = np.sort(np.concatenate([ds.train_client_idx[c] for c in range(4)]))
+    np.testing.assert_array_equal(covered, np.arange(40))
+
+
+def test_mnist_npz(tmp_path):
+    from fedml_tpu.data.mnist import load_mnist
+
+    rng = np.random.RandomState(1)
+    np.savez(
+        tmp_path / "mnist.npz",
+        x_train=rng.randint(0, 256, (30, 28, 28), dtype=np.uint8),
+        y_train=rng.randint(0, 10, 30),
+        x_test=rng.randint(0, 256, (6, 28, 28), dtype=np.uint8),
+        y_test=rng.randint(0, 10, 6),
+    )
+    ds = load_mnist(str(tmp_path), num_clients=3, flatten=False)
+    assert ds.train_x.shape == (30, 28, 28, 1)
+    assert float(ds.train_x.max()) <= 1.0
+
+
+def test_mnist_leaf_json(tmp_path):
+    """The reference's actual MNIST format: LEAF power-law JSON, one
+    user per client (MNIST/data_loader.py:8-123)."""
+    from fedml_tpu.data.mnist import load_mnist
+
+    rng = np.random.RandomState(2)
+    (tmp_path / "train").mkdir()
+    (tmp_path / "test").mkdir()
+
+    def blob(counts):
+        users = [f"f_{i:05d}" for i in range(len(counts))]
+        return {
+            "users": users,
+            "num_samples": counts,
+            "user_data": {
+                u: {
+                    "x": rng.rand(n, 784).round(4).tolist(),
+                    "y": rng.randint(0, 10, n).tolist(),
+                }
+                for u, n in zip(users, counts)
+            },
+        }
+
+    (tmp_path / "train" / "all_data_0.json").write_text(
+        json.dumps(blob([5, 3, 7]))
+    )
+    (tmp_path / "test" / "all_data_0.json").write_text(
+        json.dumps(blob([2, 2, 2]))
+    )
+    ds = load_mnist(str(tmp_path), flatten=True)
+    assert ds.train_x.shape == (15, 784)
+    assert len(ds.train_client_idx) == 3
+    # natural per-user partition, contiguous offsets
+    np.testing.assert_array_equal(ds.train_client_idx[0], np.arange(5))
+    np.testing.assert_array_equal(ds.train_client_idx[1], np.arange(5, 8))
+    np.testing.assert_array_equal(ds.train_client_idx[2], np.arange(8, 15))
+    assert len(ds.test_client_idx) == 3
+
+
+def test_mnist_leaf_test_matched_by_user_id(tmp_path):
+    """Test partitions must follow the TRAIN user-id order even when the
+    test file lists users differently or omits one."""
+    from fedml_tpu.data.mnist import load_mnist
+
+    rng = np.random.RandomState(20)
+    (tmp_path / "train").mkdir()
+    (tmp_path / "test").mkdir()
+
+    def blob(users_counts):
+        return {
+            "users": [u for u, _ in users_counts],
+            "num_samples": [n for _, n in users_counts],
+            "user_data": {
+                u: {"x": rng.rand(n, 784).round(3).tolist(),
+                    "y": (np.full(n, i) % 10).tolist()}
+                for i, (u, n) in enumerate(users_counts)
+            },
+        }
+
+    (tmp_path / "train" / "a.json").write_text(
+        json.dumps(blob([("alice", 4), ("bob", 2), ("carol", 3)])))
+    # test lists bob first and omits carol entirely
+    (tmp_path / "test" / "a.json").write_text(
+        json.dumps(blob([("bob", 5), ("alice", 1)])))
+    ds = load_mnist(str(tmp_path))
+    assert len(ds.train_client_idx) == 3
+    # slot 0 = alice: 1 test row; slot 1 = bob: 5; slot 2 = carol: empty
+    assert len(ds.test_client_idx[0]) == 1
+    assert len(ds.test_client_idx[1]) == 5
+    assert len(ds.test_client_idx[2]) == 0
+
+
+def test_mnist_non_leaf_json_falls_through_to_idx(tmp_path):
+    """Stray non-LEAF json under train/+test/ must not hijack the load:
+    the IDX files still win (documented preference order)."""
+    from fedml_tpu.data.mnist import load_mnist
+
+    rng = np.random.RandomState(21)
+    (tmp_path / "train").mkdir()
+    (tmp_path / "test").mkdir()
+    (tmp_path / "train" / "metadata.json").write_text('{"k": 1}')
+    (tmp_path / "test" / "metadata.json").write_text('{"k": 2}')
+    _write_idx(tmp_path / "train-images-idx3-ubyte",
+               rng.randint(0, 256, (20, 28, 28)))
+    _write_idx(tmp_path / "train-labels-idx1-ubyte", rng.randint(0, 10, 20))
+    _write_idx(tmp_path / "t10k-images-idx3-ubyte",
+               rng.randint(0, 256, (4, 28, 28)))
+    _write_idx(tmp_path / "t10k-labels-idx1-ubyte", rng.randint(0, 10, 4))
+    ds = load_mnist(str(tmp_path), num_clients=2)
+    assert ds.name == "mnist"
+    assert ds.train_x.shape == (20, 784)
+
+
+# ---------- CIFAR ----------
+
+def test_cifar10_pickles(tmp_path):
+    from fedml_tpu.data.cifar import CIFAR10_MEAN, CIFAR10_STD, load_cifar10
+
+    rng = np.random.RandomState(3)
+    d = tmp_path / "cifar-10-batches-py"
+    d.mkdir()
+    raw = {}
+    for i in range(1, 6):
+        data = rng.randint(0, 256, (4, 3072), dtype=np.uint8)
+        labels = rng.randint(0, 10, 4).tolist()
+        raw[i] = (data, labels)
+        with open(d / f"data_batch_{i}", "wb") as f:
+            pickle.dump({"data": data, "labels": labels}, f)
+    with open(d / "test_batch", "wb") as f:
+        pickle.dump(
+            {"data": rng.randint(0, 256, (4, 3072), dtype=np.uint8),
+             "labels": rng.randint(0, 10, 4).tolist()}, f)
+
+    ds = load_cifar10(str(tmp_path), num_clients=2, partition="homo")
+    assert ds.train_x.shape == (20, 32, 32, 3)
+    assert ds.test_x.shape == (4, 32, 32, 3)
+    # CHW->HWC transpose + reference normalization, checked exactly
+    want = raw[1][0][0].reshape(3, 32, 32).transpose(1, 2, 0).astype(np.float32)
+    want = (want / 255.0 - np.asarray(CIFAR10_MEAN, np.float32)) / np.asarray(
+        CIFAR10_STD, np.float32
+    )
+    np.testing.assert_allclose(ds.train_x[0], want, atol=1e-5)
+    assert ds.train_y[0] == raw[1][1][0]
+
+
+def test_cifar100_pickles(tmp_path):
+    from fedml_tpu.data.cifar import load_cifar100
+
+    rng = np.random.RandomState(4)
+    d = tmp_path / "cifar-100-python"
+    d.mkdir()
+    for name, n in (("train", 30), ("test", 6)):
+        with open(d / name, "wb") as f:
+            pickle.dump(
+                {"data": rng.randint(0, 256, (n, 3072), dtype=np.uint8),
+                 "fine_labels": rng.randint(0, 100, n).tolist()}, f)
+    ds = load_cifar100(str(tmp_path), num_clients=3, partition="homo")
+    assert ds.train_x.shape == (30, 32, 32, 3)
+    assert ds.num_classes == 100
+
+
+def test_cinic10_npz(tmp_path):
+    from fedml_tpu.data.cifar import load_cinic10
+
+    rng = np.random.RandomState(5)
+    np.savez(
+        tmp_path / "cinic10.npz",
+        x_train=rng.randint(0, 256, (24, 32, 32, 3), dtype=np.uint8),
+        y_train=rng.randint(0, 10, 24),
+        x_test=rng.randint(0, 256, (6, 32, 32, 3), dtype=np.uint8),
+        y_test=rng.randint(0, 10, 6),
+    )
+    ds = load_cinic10(str(tmp_path), num_clients=2, partition="homo")
+    assert ds.name == "cinic10"
+    assert ds.train_x.shape == (24, 32, 32, 3)
+
+
+# ---------- TFF h5 (FEMNIST / fed_CIFAR100) ----------
+
+def test_femnist_h5(tmp_path):
+    import h5py
+
+    from fedml_tpu.data.emnist import load_femnist
+
+    rng = np.random.RandomState(6)
+    counts = {"c00": 5, "c01": 3}
+    for split, fname in (("tr", "fed_emnist_train.h5"),
+                         ("te", "fed_emnist_test.h5")):
+        with h5py.File(tmp_path / fname, "w") as f:
+            ex = f.create_group("examples")
+            for cid, n in counts.items():
+                g = ex.create_group(cid)
+                g.create_dataset("pixels", data=rng.rand(n, 28, 28))
+                g.create_dataset("label", data=rng.randint(0, 62, n))
+    ds = load_femnist(str(tmp_path))
+    assert ds.train_x.shape == (8, 28, 28, 1)
+    assert len(ds.train_client_idx) == 2
+    np.testing.assert_array_equal(ds.train_client_idx[0], np.arange(5))
+    np.testing.assert_array_equal(ds.train_client_idx[1], np.arange(5, 8))
+    assert ds.num_classes == 62
+
+
+def test_fed_cifar100_h5(tmp_path):
+    import h5py
+
+    from fedml_tpu.data.emnist import load_fed_cifar100
+
+    rng = np.random.RandomState(7)
+    for fname in ("fed_cifar100_train.h5", "fed_cifar100_test.h5"):
+        with h5py.File(tmp_path / fname, "w") as f:
+            ex = f.create_group("examples")
+            for cid in ("u0", "u1", "u2"):
+                g = ex.create_group(cid)
+                g.create_dataset(
+                    "image", data=rng.randint(0, 256, (4, 24, 24, 3)))
+                g.create_dataset("label", data=rng.randint(0, 100, 4))
+    ds = load_fed_cifar100(str(tmp_path))
+    assert ds.train_x.shape == (12, 24, 24, 3)
+    assert float(ds.train_x.max()) <= 1.0  # /255 applied
+    assert len(ds.train_client_idx) == 3
+
+
+# ---------- Shakespeare ----------
+
+def test_shakespeare_leaf_json(tmp_path):
+    from fedml_tpu.data.shakespeare import _CHAR_TO_ID, load_shakespeare
+
+    (tmp_path / "train").mkdir()
+    (tmp_path / "test").mkdir()
+    line = "the quick brown fox jumps over the lazy dog " * 2  # 88 chars
+    window = line[:80]
+    nxt = line[80]
+    blob = {
+        "users": ["ROMEO", "JULIET"],
+        "user_data": {
+            "ROMEO": {"x": [window, window], "y": [nxt, nxt]},
+            "JULIET": {"x": [window], "y": [nxt]},
+        },
+    }
+    (tmp_path / "train" / "all_data_train.json").write_text(json.dumps(blob))
+    (tmp_path / "test" / "all_data_test.json").write_text(json.dumps(blob))
+    ds = load_shakespeare(str(tmp_path))
+    assert ds.name == "shakespeare"
+    assert ds.train_x.shape == (3, 80)
+    assert ds.train_y.shape == (3,)
+    assert ds.train_x[0, 0] == _CHAR_TO_ID["t"]
+    assert ds.train_y[0] == _CHAR_TO_ID[nxt]
+    assert len(ds.train_client_idx) == 2
+
+
+def test_fed_shakespeare_h5(tmp_path):
+    import h5py
+
+    from fedml_tpu.data.shakespeare import SEQ_LEN, load_fed_shakespeare
+
+    text = ("to be or not to be that is the question " * 5).encode()  # 200B
+    for fname in ("shakespeare_train.h5", "shakespeare_test.h5"):
+        with h5py.File(tmp_path / fname, "w") as f:
+            ex = f.create_group("examples")
+            for cid in ("HAMLET", "OPHELIA"):
+                g = ex.create_group(cid)
+                g.create_dataset(
+                    "snippets", data=np.array([text], dtype=bytes))
+    ds = load_fed_shakespeare(str(tmp_path))
+    # 200 chars -> 2 non-overlapping 80-char windows per client
+    assert ds.train_x.shape == (4, SEQ_LEN)
+    assert ds.train_y.shape == (4, SEQ_LEN)  # per-position next char
+    # y is x shifted by one within the same text stream
+    np.testing.assert_array_equal(ds.train_x[0, 1:], ds.train_y[0, :-1])
+    assert len(ds.train_client_idx) == 2
+
+
+# ---------- StackOverflow ----------
+
+def test_stackoverflow_nwp_h5(tmp_path):
+    import h5py
+
+    from fedml_tpu.data.stackoverflow import NWP_SEQ_LEN, load_stackoverflow_nwp
+
+    rng = np.random.RandomState(8)
+    with h5py.File(tmp_path / "stackoverflow_train.h5", "w") as f:
+        ex = f.create_group("examples")
+        for cid in ("u0", "u1"):
+            ex.create_group(cid).create_dataset(
+                "tokens",
+                data=rng.randint(1, 100, (3, NWP_SEQ_LEN + 1)))
+    ds = load_stackoverflow_nwp(str(tmp_path), num_clients=2)
+    assert ds.train_x.shape == (6, NWP_SEQ_LEN)
+    assert ds.train_y.shape == (6, NWP_SEQ_LEN)
+    np.testing.assert_array_equal(ds.train_x[0, 1:], ds.train_y[0, :-1])
+    assert len(ds.train_client_idx) == 2
+
+
+def test_stackoverflow_lr_h5(tmp_path):
+    import h5py
+
+    from fedml_tpu.data.stackoverflow import load_stackoverflow_lr
+
+    rng = np.random.RandomState(9)
+    with h5py.File(tmp_path / "stackoverflow_lr_train.h5", "w") as f:
+        f.create_dataset("x", data=rng.rand(8, 50))
+        f.create_dataset("y", data=(rng.rand(8, 5) > 0.7).astype(np.float32))
+        f.create_dataset("client_ptr", data=np.arange(8).reshape(2, 4))
+    ds = load_stackoverflow_lr(str(tmp_path), num_tags=5)
+    assert ds.train_x.shape == (8, 50)
+    assert ds.train_y.shape == (8, 5)
+    np.testing.assert_array_equal(ds.train_client_idx[1], np.arange(4, 8))
+
+
+# ---------- ImageNet / Landmarks ----------
+
+def test_imagenet_npz(tmp_path):
+    from fedml_tpu.data.imagenet import load_imagenet
+
+    rng = np.random.RandomState(10)
+    np.savez(
+        tmp_path / "imagenet_federated.npz",
+        x_train=rng.rand(12, 16, 16, 3), y_train=rng.randint(0, 1000, 12),
+        x_test=rng.rand(4, 16, 16, 3), y_test=rng.randint(0, 1000, 4),
+    )
+    ds = load_imagenet(str(tmp_path), num_clients=3)
+    assert ds.name == "imagenet"
+    assert ds.train_x.shape == (12, 16, 16, 3)
+    assert len(ds.train_client_idx) == 3
+
+
+def test_landmarks_npz_user_split(tmp_path):
+    """Landmarks' CSV user->image map becomes the npz user_train column:
+    the natural per-photographer partition must be honored exactly."""
+    from fedml_tpu.data.imagenet import load_landmarks
+
+    rng = np.random.RandomState(11)
+    users = np.array([7, 7, 3, 3, 3, 9])
+    np.savez(
+        tmp_path / "gld23k_federated.npz",
+        x_train=rng.rand(6, 8, 8, 3), y_train=rng.randint(0, 203, 6),
+        x_test=rng.rand(2, 8, 8, 3), y_test=rng.randint(0, 203, 2),
+        user_train=users,
+    )
+    ds = load_landmarks(str(tmp_path), variant="gld23k")
+    assert len(ds.train_client_idx) == 3  # users 3, 7, 9
+    np.testing.assert_array_equal(ds.train_client_idx[0], [2, 3, 4])  # user 3
+    np.testing.assert_array_equal(ds.train_client_idx[1], [0, 1])     # user 7
+    np.testing.assert_array_equal(ds.train_client_idx[2], [5])        # user 9
+
+
+# ---------- Tabular ----------
+
+def test_uci_csv_stream(tmp_path):
+    from fedml_tpu.data.tabular import load_uci_stream
+
+    rng = np.random.RandomState(12)
+    rows = np.column_stack([
+        rng.randint(0, 2, 200).astype(float), rng.randn(200, 5)
+    ])
+    np.savetxt(tmp_path / "SUSY.csv", rows, delimiter=",")
+    ds = load_uci_stream("SUSY", str(tmp_path), num_clients=2)
+    assert ds.name == "uci_SUSY"
+    assert ds.test_x.shape == (40, 5)  # holdout = min(64, 200//5)
+    assert len(ds.train_client_idx[0]) == 80  # (200-40)//2
+    # stream order preserved: client 0 gets the first rows verbatim
+    np.testing.assert_allclose(
+        ds.train_x[0], rows[0, 1:].astype(np.float32), atol=1e-6
+    )
+    np.testing.assert_array_equal(
+        ds.train_y[:5], rows[:5, 0].astype(np.int32)
+    )
+    # a tiny real file must still produce a non-degenerate split
+    small = rows[:20]
+    np.savetxt(tmp_path / "RO.csv", small, delimiter=",")
+    ds2 = load_uci_stream("RO", str(tmp_path), num_clients=2)
+    assert len(ds2.train_x) > 0 and len(ds2.test_x) > 0
+
+
+def test_lending_club_npz(tmp_path):
+    from fedml_tpu.data.tabular import load_lending_club
+
+    rng = np.random.RandomState(13)
+    np.savez(tmp_path / "loan_processed.npz",
+             x=rng.randn(20, 10), y=rng.randint(0, 2, 20))
+    x, y, splits = load_lending_club(str(tmp_path), num_hosts=1)
+    assert x.shape == (20, 10)
+    assert len(splits) == 2  # guest + 1 host
+    assert splits[0].stop == 5 and splits[1].start == 5
+
+
+def test_nus_wide_npz(tmp_path):
+    from fedml_tpu.data.tabular import load_nus_wide
+
+    rng = np.random.RandomState(14)
+    np.savez(tmp_path / "nus_wide_processed.npz",
+             x=rng.randn(16, 30), y=rng.randint(0, 2, 16), guest_dim=12)
+    x, y, splits = load_nus_wide(str(tmp_path))
+    assert splits[0] == slice(0, 12)
+    assert splits[1] == slice(12, 30)

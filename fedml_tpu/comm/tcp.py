@@ -77,6 +77,18 @@ class TcpHub:
                     frame = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # drop malformed frame, keep the connection
+                if frame.get("__hub__") == "peers":
+                    # membership introspection: reply to THIS node with
+                    # the currently registered ids (startup barrier —
+                    # frames to unregistered receivers are dropped, so
+                    # coordinators must await their cohort first)
+                    with self._lock:
+                        ids = sorted(self._conns)
+                    self._forward(
+                        node_id,
+                        (json.dumps({"__hub__": "peers", "ids": ids}) + "\n").encode(),
+                    )
+                    continue
                 if frame.get("__hub__") == "stop":
                     break
                 receiver = frame.get("receiver")
@@ -146,6 +158,39 @@ class TcpBackend(CommBackend):
         # to_json() is already one valid JSON line (newlines escape inside
         # JSON strings) — no re-parse needed
         self._sock.sendall((msg.to_json() + "\n").encode())
+
+    def await_peers(self, ids, timeout: float = 60.0) -> None:
+        """Block until every node id in ``ids`` is registered at the hub.
+
+        MUST be called before ``run()`` (it reads replies off the shared
+        socket); pre-protocol, the only inbound frames are peers
+        replies, so the read is unambiguous.  This is the startup
+        barrier: the hub drops frames to unregistered receivers, so a
+        coordinator that broadcasts before its cohort registered would
+        hang the federation.
+        """
+        import time as _time
+
+        want = set(int(i) for i in ids)
+        deadline = _time.monotonic() + timeout
+        while _time.monotonic() < deadline:
+            self._sock.sendall(
+                (json.dumps({"__hub__": "peers"}) + "\n").encode()
+            )
+            line = self._file.readline()
+            if not line:
+                raise ConnectionError(
+                    f"node {self.node_id}: hub closed during await_peers"
+                )
+            frame = json.loads(line)
+            if frame.get("__hub__") == "peers":
+                if want <= set(frame.get("ids", [])):
+                    return
+                _time.sleep(0.05)
+        raise TimeoutError(
+            f"node {self.node_id}: peers {sorted(want)} not all registered "
+            f"within {timeout}s"
+        )
 
     def run(self) -> None:
         while not self._stopped.is_set():

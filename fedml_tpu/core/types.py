@@ -23,7 +23,7 @@ from __future__ import annotations
 
 import dataclasses
 import threading
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -61,6 +61,59 @@ class FedDataset:
         return np.array(
             [len(self.train_client_idx[c]) for c in range(self.num_clients)],
             dtype=np.int32,
+        )
+
+    def subset_for_clients(self, client_ids: Sequence[int]) -> "FedDataset":
+        """Host-local view holding ONLY the named clients' rows.
+
+        The reference's distributed loaders materialize just the local
+        rank's partition (``load_partition_data_distributed_cifar10``,
+        ``/root/reference/fedml_api/data_preprocessing/cifar10/data_loader.py:201-233``);
+        this is the same contract for a pod: each host calls
+        ``subset_for_clients(host_client_range(...))`` and never holds —
+        or, with a loader's ``client_filter``, never parses — the other
+        hosts' data.  Client keys KEEP their original ids (only the row
+        indices are compacted), so ``pack_clients`` on the subset is
+        bit-identical to packing the same clients from the full dataset
+        (per-client pack seeding is id-keyed).  Test rows are kept whole
+        when there is no per-client test split (every host evaluates the
+        global test set), and subset per-client otherwise.
+        """
+        client_ids = list(client_ids)
+        missing = [c for c in client_ids if c not in self.train_client_idx]
+        if missing:
+            raise KeyError(f"clients not in dataset: {missing}")
+        order = np.concatenate(
+            [np.asarray(self.train_client_idx[c], np.int64) for c in client_ids]
+        ) if client_ids else np.zeros((0,), np.int64)
+        new_idx: Dict[int, np.ndarray] = {}
+        off = 0
+        for c in client_ids:
+            n = len(self.train_client_idx[c])
+            new_idx[c] = np.arange(off, off + n)
+            off += n
+        if self.test_client_idx is None:
+            test_x, test_y, new_test_idx = self.test_x, self.test_y, None
+        else:
+            t_order = np.concatenate(
+                [np.asarray(self.test_client_idx[c], np.int64) for c in client_ids]
+            ) if client_ids else np.zeros((0,), np.int64)
+            test_x, test_y = self.test_x[t_order], self.test_y[t_order]
+            new_test_idx = {}
+            t_off = 0
+            for c in client_ids:
+                n = len(self.test_client_idx[c])
+                new_test_idx[c] = np.arange(t_off, t_off + n)
+                t_off += n
+        return FedDataset(
+            train_x=self.train_x[order],
+            train_y=self.train_y[order],
+            test_x=test_x,
+            test_y=test_y,
+            train_client_idx=new_idx,
+            test_client_idx=new_test_idx,
+            num_classes=self.num_classes,
+            name=self.name,
         )
 
     def legacy_tuple(self, batch_size: int) -> Tuple:
@@ -236,6 +289,19 @@ def pack_clients(
         mask=mask.reshape(K, steps_per_epoch, batch_size),
         num_samples=ns,
     )
+
+
+def cohort_steps_per_epoch(dataset: FedDataset, batch_size: int) -> int:
+    """Pack geometry shared by every cohort driver: steps to cover the
+    LARGEST client at ``batch_size`` (smaller clients pad-by-wrapping).
+
+    Equivalence-critical: the simulation, the multi-process federation
+    entry, and the experiment dispatcher must all pack with the same
+    geometry or their parameter-level equivalence oracles diverge — one
+    definition, three callers.
+    """
+    counts = dataset.client_sample_counts()
+    return max(1, int(np.ceil(max(int(counts.max()), 1) / batch_size)))
 
 
 def batch_eval_pack(

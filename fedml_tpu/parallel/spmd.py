@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from fedml_tpu.algorithms.fedavg import ServerState, make_round_fn
+from fedml_tpu.algorithms.fedavg import make_round_fn
 from fedml_tpu.core.client import LocalUpdateFn
 
 PyTree = Any
@@ -103,6 +103,126 @@ def shard_client_block(mesh: Mesh, pack_arrays):
     """device_put packed [C, ...] arrays sharded over the clients axis."""
     sharding = NamedSharding(mesh, P("clients"))
     return tuple(jax.device_put(jnp.asarray(a), sharding) for a in pack_arrays)
+
+
+def _devices_by_clients_index(mesh: Mesh):
+    """mesh.devices grouped by clients-axis index, regardless of where
+    the ``clients`` axis sits in ``mesh.axis_names`` (positional
+    ``mesh.devices[i]`` would silently walk the wrong axis for a
+    ('model', 'clients') mesh)."""
+    ax = mesh.axis_names.index("clients")
+    moved = np.moveaxis(np.asarray(mesh.devices), ax, 0)
+    return [list(moved[i].flat) for i in range(moved.shape[0])]
+
+
+def host_client_range(
+    mesh: Mesh,
+    num_slots: int,
+    *,
+    process_index: Optional[int] = None,
+    host_of_device=None,
+) -> range:
+    """The contiguous client-slot range owned by this host's devices.
+
+    Under ``NamedSharding(mesh, P("clients"))`` slot ``k`` lives on the
+    devices at clients-axis index ``k // (num_slots / n_clients_axis)``.
+    A host's slots are the union over its devices — the per-rank
+    partition of the reference's distributed loaders
+    (``cifar10/data_loader.py:201-233``), derived from the mesh instead
+    of an MPI rank argument.
+
+    ``host_of_device`` maps a device to its host id (default: the real
+    ``device.process_index``); tests inject a fake mapping to simulate a
+    multi-host pod on a single-process CPU mesh.
+    """
+    if host_of_device is None:
+        host_of_device = lambda d: d.process_index  # noqa: E731
+    if process_index is None:
+        process_index = jax.process_index()
+    n_cl = mesh.shape["clients"]
+    if num_slots % n_cl:
+        raise ValueError(f"{num_slots} slots not divisible by clients axis {n_cl}")
+    block = num_slots // n_cl
+    dev_rows = _devices_by_clients_index(mesh)
+    mine = [
+        i
+        for i in range(n_cl)
+        if any(host_of_device(d) == process_index for d in dev_rows[i])
+    ]
+    if not mine:
+        return range(0)
+    lo, hi = min(mine), max(mine)
+    if mine != list(range(lo, hi + 1)):
+        raise ValueError(
+            "host's devices are not contiguous along the clients axis; "
+            "reorder the mesh so each host owns one slot range"
+        )
+    return range(lo * block, (hi + 1) * block)
+
+
+def shard_client_block_local(
+    mesh: Mesh,
+    num_slots: int,
+    shards_by_slot_start,
+):
+    """Assemble globally-sharded [C, ...] arrays from per-host blocks.
+
+    ``shards_by_slot_start`` maps a slot start to the tuple of host
+    arrays covering a contiguous slot range (each host contributes the
+    range from its ``host_client_range`` and NEVER materializes the
+    rest).  The global ``jax.Array`` is built with
+    ``jax.make_array_from_single_device_arrays``, whose contract is
+    exactly this: every process supplies only its addressable shards.
+    (A single-process test passes all ranges, split across simulated
+    hosts upstream.)
+    """
+    sharding = NamedSharding(mesh, P("clients"))
+    n_cl = mesh.shape["clients"]
+    block = num_slots // n_cl
+    if not shards_by_slot_start:
+        # A host whose devices are outside this mesh owns no slot range
+        # (host_client_range -> range(0)) — but such a host also has no
+        # addressable shards here and cannot legally participate in a
+        # computation over this mesh at all; assembling from it is a
+        # caller bug, not a degenerate case to paper over.
+        raise ValueError(
+            "no slot ranges supplied; a host with host_client_range() == "
+            "range(0) has no devices in this mesh and must not join its "
+            "computations"
+        )
+    n_arrays = len(next(iter(shards_by_slot_start.values())))
+    # slot start -> (host array tuple, offset of that device block inside it)
+    covering = {}
+    for start, arrays in shards_by_slot_start.items():
+        rows = np.asarray(arrays[0]).shape[0]
+        if start % block or rows % block:
+            raise ValueError(
+                f"range [{start}, {start + rows}) is not aligned to the "
+                f"per-device block of {block} slots"
+            )
+        for i in range(start // block, (start + rows) // block):
+            covering[i * block] = (arrays, i * block - start)
+    dev_rows = _devices_by_clients_index(mesh)
+    out = []
+    for j in range(n_arrays):
+        buffers = []
+        sample = None
+        for i in range(n_cl):
+            entry = covering.get(i * block)
+            if entry is None:
+                continue  # another host's range (its process supplies it)
+            arrays, off = entry
+            piece = jnp.asarray(np.asarray(arrays[j])[off : off + block])
+            sample = piece
+            for d in dev_rows[i]:
+                buffers.append(jax.device_put(piece, d))
+        global_shape = (num_slots,) + tuple(sample.shape[1:])
+        out.append(
+            jax.make_array_from_single_device_arrays(
+                global_shape, sharding, buffers
+            )
+        )
+    return tuple(out)
 
 
 def replicate(mesh: Mesh, tree: PyTree) -> PyTree:

@@ -110,3 +110,70 @@ def test_mesh_reserves_model_axis():
     mesh = make_client_mesh(8, model_axis=2)
     assert mesh.shape["clients"] == 4
     assert mesh.shape["model"] == 2
+
+
+def test_host_sharded_packing_matches_single_host():
+    """Pod-scale data loading (VERDICT r1 #8): simulate a 2-host pod on
+    the 8-device CPU mesh, give each "host" ONLY its own clients' rows
+    (``subset_for_clients``), pack locally, assemble the global sharded
+    block with ``shard_client_block_local`` — and the SPMD round must be
+    bit-identical to the everything-on-one-host path.
+
+    Mirrors the reference's per-rank loaders
+    (``cifar10/data_loader.py:201-233``), which hand each MPI rank only
+    its own partition.
+    """
+    from fedml_tpu.parallel.spmd import (
+        host_client_range,
+        shard_client_block_local,
+    )
+
+    ds, bundle, local_update, pack, state = _setup()
+    mesh = make_client_mesh(8)
+    n = pack.num_clients
+    host_of = lambda d: 0 if d.id < 4 else 1  # noqa: E731
+
+    ranges = {}
+    shards = {}
+    for host in (0, 1):
+        r = host_client_range(
+            mesh, n, process_index=host, host_of_device=host_of
+        )
+        ranges[host] = r
+        local_ids = list(r)
+        local_ds = ds.subset_for_clients(local_ids)
+        # the host-local dataset holds ONLY its clients' rows
+        want_rows = sum(len(ds.train_client_idx[c]) for c in local_ids)
+        assert len(local_ds.train_x) == want_rows < len(ds.train_x)
+        local_pack = pack_clients(
+            local_ds, local_ids, batch_size=16, seed=0,
+            steps_per_epoch=pack.steps_per_epoch,
+        )
+        # id-keyed pack seeding: host-local pack == global pack's rows
+        np.testing.assert_array_equal(local_pack.x, pack.x[list(r)])
+        shards[r.start] = (
+            local_pack.x, local_pack.y, local_pack.mask,
+            local_pack.num_samples,
+            np.ones(len(local_ids), np.float32),
+            np.arange(r.start, r.stop, dtype=np.int32),
+        )
+    assert ranges[0] == range(0, 4) and ranges[1] == range(4, 8)
+
+    sharded = shard_client_block_local(mesh, n, shards)
+    spmd = make_spmd_round_fn(mesh, local_update, donate=False)
+    got_state, got_metrics = spmd(replicate(mesh, state), *sharded)
+
+    args = (
+        jnp.asarray(pack.x), jnp.asarray(pack.y), jnp.asarray(pack.mask),
+        jnp.asarray(pack.num_samples), jnp.ones(n, jnp.float32),
+        jnp.arange(n, dtype=jnp.int32),
+    )
+    ref_state, ref_metrics = spmd(
+        replicate(mesh, state), *shard_client_block(mesh, args)
+    )
+    for a, b in zip(
+        jax.tree_util.tree_leaves(ref_state.variables),
+        jax.tree_util.tree_leaves(got_state.variables),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert float(ref_metrics["loss_sum"]) == float(got_metrics["loss_sum"])

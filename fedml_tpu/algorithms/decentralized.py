@@ -25,7 +25,7 @@ import numpy as np
 
 from fedml_tpu.core.client import make_client_optimizer, make_evaluator, make_local_update
 from fedml_tpu.core.losses import LossFn, masked_softmax_ce
-from fedml_tpu.core.types import FedDataset, batch_eval_pack, pack_clients
+from fedml_tpu.core.types import FedDataset, batch_eval_pack, cohort_steps_per_epoch, pack_clients
 from fedml_tpu.models.base import ModelBundle
 
 PyTree = Any
@@ -144,8 +144,7 @@ class DecentralizedSimulation:
         self.key = key
         self.seed = seed
         self.batch_size = batch_size
-        counts = dataset.client_sample_counts()
-        self.steps_per_epoch = max(1, int(np.ceil(int(counts.max()) / batch_size)))
+        self.steps_per_epoch = cohort_steps_per_epoch(dataset, batch_size)
         self._test_pack = batch_eval_pack(dataset.test_x, dataset.test_y, 64)
         self.round_idx = 0
         self.history = []

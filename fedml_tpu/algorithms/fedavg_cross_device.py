@@ -173,10 +173,16 @@ class FedAvgClientManager(NodeManager):
         variables = tree_from_wire(msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.template)
         client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
         round_idx = msg.get(MSG_ARG_KEY_ROUND_INDEX)
+        # round-independent pack seed, matching FedAvgSimulation's
+        # device-resident cohort blocks: the pack base order carries no
+        # stochasticity (the local update re-permutes per epoch from the
+        # (key, round, slot) stream), and per-client pack seeding is
+        # id-keyed, so this single-client pack is bit-identical to the
+        # client's row in the simulation's cohort pack
         pack = pack_clients(
             self.dataset, [client_idx], self.batch_size,
             steps_per_epoch=msg.get("steps_per_epoch"),
-            seed=self.seed + round_idx,
+            seed=self.seed,
         )
         # identical stream to the compiled round engine: key→round→train→slot
         slot = msg.get("slot", client_idx)

@@ -22,7 +22,7 @@ import numpy as np
 from fedml_tpu.algorithms.fedavg import FedAvgConfig, FedAvgSimulation
 from fedml_tpu.core.losses import LossFn, masked_softmax_ce
 from fedml_tpu.core.robust import make_robust_transform
-from fedml_tpu.core.types import FedDataset, batch_eval_pack, pack_clients
+from fedml_tpu.core.types import FedDataset, batch_eval_pack
 from fedml_tpu.data.edge_case import PoisonedData, make_backdoor
 from fedml_tpu.models.base import ModelBundle
 
@@ -68,48 +68,57 @@ class FedAvgRobustSimulation(FedAvgSimulation):
             self.poison.backdoor_test_y,
             max(config.batch_size, 64),
         )
+        # the attacker's poisoned slot rows on device — see _poison_slot_rows
+        self._poison_slot_cache: Optional[tuple] = None
 
-    def run_round(self) -> dict:
-        round_idx = int(self.state.round_idx)
-        ids = self._sample_ids(round_idx)
-        pack = pack_clients(
-            self.dataset,
-            ids,
-            self.cfg.batch_size,
-            steps_per_epoch=self.steps_per_epoch,
-            seed=self.cfg.seed + round_idx,
-        )
-        attacking = round_idx % self.attack_freq == 0
-        if attacking and self.attacker_client in ids:
-            slot = int(np.where(ids == self.attacker_client)[0][0])
-            S, B = pack.x.shape[1], pack.x.shape[2]
-            px, py, pm = batch_eval_pack(
-                self.poison.train_x, self.poison.train_y, B
-            )
-            steps = min(S, px.shape[0])
-            x = pack.x.copy(); y = pack.y.copy(); m = pack.mask.copy()
-            x[slot], y[slot], m[slot] = 0, 0, 0.0
-            x[slot, :steps] = px[:steps]
-            y[slot, :steps] = py[:steps]
-            m[slot, :steps] = pm[:steps]
-            ns = pack.num_samples.copy()
-            ns[slot] = float(pm[:steps].sum())
-            pack = type(pack)(x=x, y=y, mask=m, num_samples=ns)
+    def _attacking(self, ids, round_idx: int) -> bool:
+        return round_idx % self.attack_freq == 0 and self.attacker_client in ids
 
-        participation = jnp.ones(len(ids), jnp.float32)
-        self.state, metrics = self.round_fn(
-            self.state,
-            jnp.asarray(pack.x), jnp.asarray(pack.y), jnp.asarray(pack.mask),
-            jnp.asarray(pack.num_samples), participation,
-            jnp.asarray(ids, jnp.int32),
+    def _poison_slot_rows(self) -> tuple:
+        """The attacker's poisoned slot — [S, B, ...] device arrays plus
+        the true sample count — built once (the poison is fixed for the
+        run).  Only this ONE slot is cached; the clean cohort block stays
+        the base class's single device-resident copy and the swap happens
+        on device (`.at[slot].set`), so the robust path never pins a
+        second full-cohort block in HBM."""
+        if self._poison_slot_cache is not None:
+            return self._poison_slot_cache
+        import jax
+
+        S, B = self.steps_per_epoch, self.cfg.batch_size
+        px, py, pm = batch_eval_pack(
+            self.poison.train_x, self.poison.train_y, B
         )
-        out = {k: float(v) for k, v in metrics.items()}
-        out["round"] = round_idx
-        out["attacking"] = bool(attacking and self.attacker_client in ids)
-        if out.get("count", 0) > 0:
-            out["train_acc"] = out["correct"] / out["count"]
-            out["train_loss"] = out["loss_sum"] / out["count"]
-        return out
+        steps = min(S, px.shape[0])
+        x = np.zeros((S, B, *px.shape[2:]), px.dtype)
+        y = np.zeros((S, B, *py.shape[2:]), py.dtype)
+        m = np.zeros((S, B), np.float32)
+        x[:steps], y[:steps], m[:steps] = px[:steps], py[:steps], pm[:steps]
+        ns = float(pm[:steps].sum())
+        rows = tuple(jax.device_put(jnp.asarray(a)) for a in (x, y, m)) + (ns,)
+        jax.block_until_ready(rows[:3])
+        self._poison_slot_cache = rows
+        return rows
+
+    def _cohort_block(self, ids, round_idx: int) -> tuple:
+        """Attack rounds swap the attacker's slot for their poisoned
+        mixture on device; non-attack rounds share the base class's
+        device-resident clean block unchanged."""
+        clean = super()._cohort_block(ids, round_idx)
+        if not self._attacking(ids, round_idx):
+            return clean
+        px, py, pm, pns = self._poison_slot_rows()
+        slot = int(np.where(np.asarray(ids) == self.attacker_client)[0][0])
+        x, y, m, ns = clean
+        return (
+            x.at[slot].set(px.astype(x.dtype)),
+            y.at[slot].set(py.astype(y.dtype)),
+            m.at[slot].set(pm),
+            ns.at[slot].set(pns),
+        )
+
+    def _annotate_round(self, out: dict, ids, round_idx: int) -> None:
+        out["attacking"] = self._attacking(ids, round_idx)
 
     def evaluate_backdoor(self) -> dict:
         """Targeted-task accuracy: fraction of triggered samples classified

@@ -31,7 +31,7 @@ import optax
 
 from fedml_tpu.core.client import make_client_optimizer
 from fedml_tpu.core.losses import masked_kd_kl, masked_softmax_ce
-from fedml_tpu.core.types import FedDataset, batch_eval_pack, pack_clients
+from fedml_tpu.core.types import FedDataset, batch_eval_pack, cohort_steps_per_epoch, pack_clients
 from fedml_tpu.models.base import ModelBundle
 
 PyTree = Any
@@ -92,8 +92,7 @@ class FedGKT:
         self.key = key
 
         # fixed pack geometry: every client padded to the max shard size
-        counts = dataset.client_sample_counts()
-        self.steps = max(1, int(np.ceil(max(int(counts.max()), 1) / config.batch_size)))
+        self.steps = cohort_steps_per_epoch(dataset, config.batch_size)
         self.pack = pack_clients(
             dataset, list(range(config.num_clients)), config.batch_size,
             steps_per_epoch=self.steps, seed=config.seed,

@@ -31,7 +31,7 @@ import optax
 
 from fedml_tpu.algorithms.fedavg import FedAvgConfig, FedAvgSimulation
 from fedml_tpu.core.losses import masked_softmax_ce
-from fedml_tpu.core.types import FedDataset, batch_eval_pack, pack_clients
+from fedml_tpu.core.types import FedDataset, batch_eval_pack, cohort_steps_per_epoch, pack_clients
 from fedml_tpu.models.darts.genotypes import Genotype, genotype_from_alphas
 from fedml_tpu.models.darts.network import darts_network
 from fedml_tpu.models.darts.search import SearchBundle
@@ -80,9 +80,7 @@ class FedNASSearch:
             round_idx=jnp.zeros((), jnp.int32),
             key=key,
         )
-        counts = dataset.client_sample_counts()
-        self.steps = max(1, int(np.ceil(max(int(counts.max()), 1)
-                                        / config.batch_size)))
+        self.steps = cohort_steps_per_epoch(dataset, config.batch_size)
         self._round_fn = jax.jit(self._build_round_fn())
         self._test_pack = batch_eval_pack(
             dataset.test_x, dataset.test_y, max(config.batch_size, 64)

@@ -29,7 +29,7 @@ from fedml_tpu.core import mpc
 from fedml_tpu.core import tree as treelib
 from fedml_tpu.core.client import make_client_optimizer, make_evaluator, make_local_update
 from fedml_tpu.core.losses import LossFn, masked_softmax_ce
-from fedml_tpu.core.types import FedDataset, batch_eval_pack, pack_clients
+from fedml_tpu.core.types import FedDataset, batch_eval_pack, cohort_steps_per_epoch, pack_clients
 from fedml_tpu.models.base import ModelBundle
 
 
@@ -137,8 +137,7 @@ class TurboAggregateSimulation:
         key = jax.random.PRNGKey(config.seed)
         self.variables = bundle.init(key)
         self.key = key
-        counts = dataset.client_sample_counts()
-        self.steps_per_epoch = max(1, int(np.ceil(int(counts.max()) / config.batch_size)))
+        self.steps_per_epoch = cohort_steps_per_epoch(dataset, config.batch_size)
         self._test_pack = batch_eval_pack(dataset.test_x, dataset.test_y, 64)
         self.round_idx = 0
         self.history: List[dict] = []

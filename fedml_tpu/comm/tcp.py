@@ -173,20 +173,37 @@ class TcpBackend(CommBackend):
 
         want = set(int(i) for i in ids)
         deadline = _time.monotonic() + timeout
-        while _time.monotonic() < deadline:
-            self._sock.sendall(
-                (json.dumps({"__hub__": "peers"}) + "\n").encode()
-            )
-            line = self._file.readline()
-            if not line:
-                raise ConnectionError(
-                    f"node {self.node_id}: hub closed during await_peers"
+        # Bound each readline by the remaining budget: the socket runs
+        # blocking (timeout None) for the normal message loop, and a hub
+        # that accepts the request but never replies (wedged process)
+        # would otherwise hang this "raises TimeoutError" function forever.
+        try:
+            while (remaining := deadline - _time.monotonic()) > 0:
+                self._sock.settimeout(max(remaining, 0.05))
+                self._sock.sendall(
+                    (json.dumps({"__hub__": "peers"}) + "\n").encode()
                 )
-            frame = json.loads(line)
-            if frame.get("__hub__") == "peers":
-                if want <= set(frame.get("ids", [])):
-                    return
-                _time.sleep(0.05)
+                try:
+                    line = self._file.readline()
+                except TimeoutError:
+                    break  # budget exhausted mid-read
+                except OSError as e:
+                    # a reset/closed socket is a dead hub, not slow peers
+                    raise ConnectionError(
+                        f"node {self.node_id}: hub connection failed during "
+                        f"await_peers: {e}"
+                    ) from e
+                if not line:
+                    raise ConnectionError(
+                        f"node {self.node_id}: hub closed during await_peers"
+                    )
+                frame = json.loads(line)
+                if frame.get("__hub__") == "peers":
+                    if want <= set(frame.get("ids", [])):
+                        return
+                    _time.sleep(0.05)
+        finally:
+            self._sock.settimeout(None)
         raise TimeoutError(
             f"node {self.node_id}: peers {sorted(want)} not all registered "
             f"within {timeout}s"

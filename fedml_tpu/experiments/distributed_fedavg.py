@@ -29,7 +29,6 @@ import argparse
 import json
 import os
 import signal
-import socket
 import subprocess
 import sys
 import time
@@ -109,8 +108,9 @@ def run_server(args) -> None:
     # cohort-wide pack geometry (fedavg_cross_device.py:62-66): each
     # client's single-client pack must match its slice of the
     # simulation's cohort pack even with heterogeneous client sizes
-    counts = ds.client_sample_counts()
-    steps = max(1, int(np.ceil(max(int(counts.max()), 1) / args.batch_size)))
+    from fedml_tpu.core.types import cohort_steps_per_epoch
+
+    steps = cohort_steps_per_epoch(ds, args.batch_size)
     server = FedAvgServerManager(
         backend, init, num_clients=args.num_clients,
         clients_per_round=args.clients_per_round or args.num_clients,

@@ -21,7 +21,6 @@ import dataclasses
 import json
 import sys
 import time
-from typing import Optional
 
 from fedml_tpu.core.config import config_to_json, parse_config
 from fedml_tpu.experiments.registry import create_model, load_data
@@ -181,8 +180,9 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
     )
     state = shard_state(state)
     hist = []
-    counts = ds.client_sample_counts()
-    steps = max(1, int(np.ceil(max(int(counts.max()), 1) / cfg.batch_size)))
+    from fedml_tpu.core.types import cohort_steps_per_epoch
+
+    steps = cohort_steps_per_epoch(ds, cfg.batch_size)
     from fedml_tpu.core.sampling import host_sample_ids
 
     # same evaluator + cadence as the tp_degree==1 simulation driver, so
@@ -202,8 +202,11 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
     for r in range(cfg.comm_round):
         # shared sampler: tp_degree=1 and >1 runs are cohort-comparable
         ids = host_sample_ids(cfg.seed, r, ds.num_clients, K)
+        # round-independent pack seed: same convention as the simulation
+        # and cross-device drivers (the local update re-permutes per
+        # epoch on-device; the base order carries no stochasticity)
         pack = pack_clients(ds, ids, cfg.batch_size, steps_per_epoch=steps,
-                            seed=cfg.seed + r, reuse_buffers=True)
+                            seed=cfg.seed, reuse_buffers=True)
         participation = np.ones(K, np.float32)
         if cfg.drop_prob > 0.0:
             from fedml_tpu.core.sampling import inject_dropout

@@ -3,21 +3,35 @@
 Reference ``fedml_api/data_preprocessing/edge_case_examples/data_loader.py:283-360``
 loads pre-built poisoned sets (southwest-airline CIFAR backdoors,
 ARDIS-7 MNIST digits, green cars) where out-of-distribution examples
-are labeled with an attacker-chosen target class.  Those archives are
-external downloads; offline, this module synthesizes the same *shape*
-of attack generically: a pixel-pattern trigger stamped on real samples,
-relabeled to ``target_label``.
+are labeled with an attacker-chosen target class.
 
-Produces the attacker's training mixture (poison fraction mixed into
-their honest shard, reference ``:300-340`` mixing logic) and the
-backdoor test set used for targeted-accuracy measurement
-(``FedAvgRobustAggregator`` "targeted task" eval, SURVEY.md §2 row 13).
+Two attack shapes are provided:
+
+- **Edge-case / OOD label-flip** (``make_edge_case_backdoor``) — the
+  reference's semantics mirrored exactly (``data_loader.py:380-440``):
+  sample N out-of-distribution images (southwest planes), label them all
+  ``target_label`` (9 = CIFAR "truck"), mix with M downsampled clean
+  samples into the attacker's training set; the targeted-task test set
+  is the OOD *test* images, all labeled ``target_label``.  The real
+  southwest/ARDIS archives are external downloads unavailable in this
+  zero-egress environment; ``load_edge_case_images`` reads them
+  (pickled uint8 image arrays) when present, and
+  ``synthetic_ood_images`` generates a stand-in distribution otherwise.
+- **Pixel-trigger backdoor** (``make_backdoor``) — a pattern stamped on
+  real samples, relabeled to ``target_label`` (the classic BadNets
+  shape, used by the robust-aggregation tests).
+
+Both produce the attacker's training mixture and the backdoor test set
+used for targeted-accuracy measurement (``FedAvgRobustAggregator``
+"targeted task" eval, SURVEY.md §2 row 13).
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
+import os
+import pickle
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -77,4 +91,95 @@ def make_backdoor(
         train_y=mix_y[order],
         backdoor_test_x=bt_x,
         backdoor_test_y=bt_y,
+    )
+
+
+# ---------------------------------------------------------------------------
+# edge-case (OOD label-flip) attack — the reference's southwest semantics
+# ---------------------------------------------------------------------------
+
+_TRAIN_PKL = "southwest_images_new_train.pkl"
+_TEST_PKL = "southwest_images_new_test.pkl"
+
+
+def load_edge_case_images(
+    data_dir: str,
+    train_name: str = _TRAIN_PKL,
+    test_name: str = _TEST_PKL,
+) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """Read the reference's edge-case archives when present.
+
+    Format (``data_loader.py:355-360``): each .pkl is a pickled uint8
+    image ndarray ``[N, 32, 32, 3]``.  Returns float32 images scaled to
+    [0, 1] (our pipelines' convention), or None if the files are absent
+    (they are external downloads; this environment has no egress).
+    """
+    tr, te = os.path.join(data_dir, train_name), os.path.join(data_dir, test_name)
+    if not (os.path.exists(tr) and os.path.exists(te)):
+        return None
+    with open(tr, "rb") as f:
+        train = pickle.load(f)
+    with open(te, "rb") as f:
+        test = pickle.load(f)
+
+    def norm(a):
+        a = np.asarray(a)
+        return a.astype(np.float32) / 255.0 if a.dtype == np.uint8 else a.astype(np.float32)
+
+    return norm(train), norm(test)
+
+
+def synthetic_ood_images(
+    shape: Tuple[int, ...],
+    num_train: int = 200,
+    num_test: int = 100,
+    seed: int = 7,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Offline stand-in for the southwest archive: one out-of-distribution
+    prototype (not any class prototype of ``synthetic_classification``)
+    plus noise — the same 'coherent cluster far from the training
+    manifold' structure that makes edge-case attacks hard to detect."""
+    rng = np.random.RandomState(seed)
+    proto = rng.normal(3.0, 1.0, shape).astype(np.float32)  # shifted mean: OOD
+    mk = lambda n: proto + rng.normal(0, 0.3, (n, *shape)).astype(np.float32)  # noqa: E731
+    return mk(num_train), mk(num_test)
+
+
+def make_edge_case_backdoor(
+    dataset: FedDataset,
+    ood_train: np.ndarray,
+    ood_test: np.ndarray,
+    target_label: int = 9,
+    num_poison: int = 100,
+    num_clean: int = 400,
+    seed: int = 0,
+) -> PoisonedData:
+    """The reference's edge-case attack, exactly (``data_loader.py:380-440``):
+
+    - sample ``num_poison`` (reference N=100) OOD train images without
+      replacement, all labeled ``target_label`` (reference: 9, "southwest
+      airplane -> label as truck");
+    - downsample ``num_clean`` (reference M=400) clean train samples;
+    - the attacker's set is their concatenation (the DataLoader shuffles;
+      here the pack's per-client permutation does);
+    - the targeted-task test set is the OOD *test* images, all labeled
+      ``target_label`` (reference ``poisoned_testset``).
+    """
+    rng = np.random.RandomState(seed)
+    n_poison = min(num_poison, len(ood_train))
+    pick = rng.choice(len(ood_train), n_poison, replace=False)
+    poison_x = ood_train[pick]
+    poison_y = np.full(n_poison, target_label, dtype=dataset.train_y.dtype)
+
+    n_clean = min(num_clean, len(dataset.train_x))
+    clean_pick = rng.choice(len(dataset.train_x), n_clean, replace=False)
+    clean_x = dataset.train_x[clean_pick]
+    clean_y = dataset.train_y[clean_pick]
+
+    return PoisonedData(
+        train_x=np.concatenate([clean_x, poison_x]).astype(np.float32),
+        train_y=np.concatenate([clean_y, poison_y]),
+        backdoor_test_x=np.asarray(ood_test, np.float32),
+        backdoor_test_y=np.full(len(ood_test), target_label,
+                                dtype=dataset.test_y.dtype),
     )

@@ -451,3 +451,49 @@ def test_nus_wide_npz(tmp_path):
     x, y, splits = load_nus_wide(str(tmp_path))
     assert splits[0] == slice(0, 12)
     assert splits[1] == slice(12, 30)
+
+
+def test_edge_case_pickle_and_label_flip_semantics(tmp_path):
+    """The reference's southwest edge-case attack, on a fixture archive:
+    pickled uint8 [N,32,32,3] images; N OOD train images labeled 9
+    ("truck") mixed with M downsampled clean samples; targeted test set
+    = OOD test images all labeled 9 (edge_case_examples/data_loader.py:380-440)."""
+    import pickle
+
+    from fedml_tpu.data.edge_case import (
+        load_edge_case_images,
+        make_edge_case_backdoor,
+        synthetic_ood_images,
+    )
+    from fedml_tpu.data.synthetic import synthetic_classification
+
+    rng = np.random.RandomState(0)
+    with open(tmp_path / "southwest_images_new_train.pkl", "wb") as f:
+        pickle.dump(rng.randint(0, 256, (6, 32, 32, 3), dtype=np.uint8), f)
+    with open(tmp_path / "southwest_images_new_test.pkl", "wb") as f:
+        pickle.dump(rng.randint(0, 256, (4, 32, 32, 3), dtype=np.uint8), f)
+
+    loaded = load_edge_case_images(str(tmp_path))
+    assert loaded is not None
+    ood_train, ood_test = loaded
+    assert ood_train.shape == (6, 32, 32, 3) and ood_train.dtype == np.float32
+    assert float(ood_train.max()) <= 1.0  # uint8 scaled to [0,1]
+    assert load_edge_case_images(str(tmp_path / "missing")) is None
+
+    ds = synthetic_classification(
+        num_train=300, num_test=40, input_shape=(32, 32, 3), num_classes=10,
+        num_clients=4, partition="homo", seed=0,
+    )
+    pd = make_edge_case_backdoor(
+        ds, ood_train, ood_test, target_label=9, num_poison=5, num_clean=20,
+        seed=1,
+    )
+    assert len(pd.train_x) == 25  # M clean + N poison
+    assert int((pd.train_y == 9).sum()) >= 5
+    np.testing.assert_array_equal(pd.backdoor_test_y, np.full(4, 9))
+    np.testing.assert_allclose(pd.backdoor_test_x, ood_test)
+
+    # offline stand-in keeps the same contract
+    tr, te = synthetic_ood_images((32, 32, 3), num_train=8, num_test=3)
+    pd2 = make_edge_case_backdoor(ds, tr, te, num_poison=100, num_clean=400)
+    assert len(pd2.train_x) == 300 + 8  # capped at what exists

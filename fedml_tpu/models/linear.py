@@ -30,3 +30,25 @@ def logistic_regression(input_dim: int = 784, num_classes: int = 10) -> ModelBun
         module=LogisticRegression(num_classes=num_classes),
         input_shape=(input_dim,),
     )
+
+
+class MLP2(nn.Module):
+    """Two-layer perceptron: the near-zero-compile stand-in the scaling
+    harness uses for CI runs (``tools/bench_scaling.py --model mlp``)."""
+
+    hidden: int = 32
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(self.hidden, dtype=jnp.float32)(x))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+def mlp2(input_dim: int, hidden: int = 32, num_classes: int = 10,
+         input_shape=None) -> ModelBundle:
+    return ModelBundle(
+        module=MLP2(hidden=hidden, num_classes=num_classes),
+        input_shape=tuple(input_shape) if input_shape else (input_dim,),
+    )

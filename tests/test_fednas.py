@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from fedml_tpu.algorithms.fedavg import FedAvgConfig
 from fedml_tpu.algorithms.fednas import FedNASConfig, FedNASSearch, fednas_train_stage
@@ -20,10 +21,13 @@ def _tiny_ds(seed=0):
 
 
 def test_search_network_forward():
-    b = darts_search(C=4, num_classes=3, layers=2, image_size=8)
+    # steps=2 (5 edges/cell instead of 14): same machinery, ~3x less XLA
+    # compile on this 1-core box; full-size search runs in the slow tier
+    b = darts_search(C=4, num_classes=3, layers=2, image_size=8, steps=2,
+                     multiplier=2)
     variables = b.init(jax.random.PRNGKey(0))
     alphas = b.init_alphas(jax.random.PRNGKey(1))
-    assert alphas["alphas_normal"].shape == (num_edges(4), len(PRIMITIVES))
+    assert alphas["alphas_normal"].shape == (num_edges(2), len(PRIMITIVES))
     x = jnp.zeros((2, 8, 8, 3))
     logits = b.apply_eval(variables, alphas, x)
     assert logits.shape == (2, 3)
@@ -50,7 +54,8 @@ def test_fednas_search_round_updates_weights_and_alphas():
     cfg = FedNASConfig(num_clients=2, comm_rounds=2, epochs=1, batch_size=6,
                        lr=0.01, arch_lr=3e-3, seed=0)
     algo = FedNASSearch(darts_search(C=4, num_classes=3, layers=2,
-                                     image_size=8), ds, cfg)
+                                     image_size=8, steps=2, multiplier=2),
+                        ds, cfg)
     a0 = np.asarray(algo.state.alphas["alphas_normal"]).copy()
     hist = algo.run()
     assert len(hist) == 2
@@ -58,6 +63,20 @@ def test_fednas_search_round_updates_weights_and_alphas():
     assert not np.allclose(a0, a1)  # architect actually stepped
     assert np.isfinite(a1).all()
     assert "test_acc" in hist[-1]
+    g = algo.genotype()
+    assert len(g.normal) == 4 and len(g.reduce) == 4  # 2*steps edges
+
+
+@pytest.mark.slow
+def test_fednas_search_full_space():
+    """Full DARTS search space (steps=4, 14 edges x 8 ops) — the
+    reference-default geometry; compile-heavy, slow tier."""
+    ds = _tiny_ds()
+    cfg = FedNASConfig(num_clients=2, comm_rounds=1, epochs=1, batch_size=6,
+                       lr=0.01, arch_lr=3e-3, seed=0)
+    algo = FedNASSearch(darts_search(C=4, num_classes=3, layers=2,
+                                     image_size=8), ds, cfg)
+    algo.run()
     g = algo.genotype()
     assert len(g.normal) == 8 and len(g.reduce) == 8
 

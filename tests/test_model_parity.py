@@ -32,7 +32,11 @@ from fedml_tpu.core.tree import tree_size
 
 
 def _params(bundle):
-    return tree_size(bundle.init(jax.random.PRNGKey(0))["params"])
+    # eval_shape: parameter COUNTS need only the abstract init tree — no
+    # XLA compilation/execution (the full EfficientNet/VGG inits cost
+    # 30-60 s each to compile on this 1-core box)
+    shapes = jax.eval_shape(bundle.init, jax.random.PRNGKey(0))
+    return tree_size(shapes["params"])
 
 
 def make_cases():

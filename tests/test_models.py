@@ -20,6 +20,7 @@ def _run(bundle, batch=2, num_classes=None):
     return logits, out, new_vars
 
 
+@pytest.mark.slow  # ~30s XLA compile; params pinned in the default tier via eval_shape
 def test_vgg11_bn_tiny():
     from fedml_tpu.models.vgg import vgg11_bn
 
@@ -49,6 +50,8 @@ def test_mobilenet_v1():
     assert logits.shape == (2, 5)
 
 
+@pytest.mark.slow  # numeric forward of the full graph: ~30-50s XLA compile;
+# construction parity is in the default tier (test_model_parity, eval_shape)
 def test_mobilenet_v3_small():
     from fedml_tpu.models.mobilenet_v3 import mobilenet_v3
 
@@ -59,6 +62,8 @@ def test_mobilenet_v3_small():
     assert np.isfinite(np.asarray(logits)).all()
 
 
+@pytest.mark.slow  # numeric forward of the full graph: ~30-50s XLA compile;
+# construction parity is in the default tier (test_model_parity, eval_shape)
 def test_efficientnet_b0_tiny():
     from fedml_tpu.models.efficientnet import efficientnet
 

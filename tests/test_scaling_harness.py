@@ -33,6 +33,7 @@ def _run(capsys, argv):
 def test_chips_mode_ladder(capsys):
     rows = _run(capsys, [
         "--mode", "chips", "--platform", "cpu", "--devices", "8",
+        "--model", "mlp",
         "--rounds", "1", "--steps", "1", "--batch", "2",
     ])
     assert [r["devices"] for r in rows] == [1, 2, 4, 8]
@@ -45,7 +46,7 @@ def test_chips_mode_ladder(capsys):
 
 def test_clients_mode_points(capsys):
     rows = _run(capsys, [
-        "--mode", "clients", "--platform", "cpu",
+        "--mode", "clients", "--platform", "cpu", "--model", "mlp",
         "--rounds", "1", "--rounds-per-call", "2",
         "--steps", "1", "--batch", "2",
     ])

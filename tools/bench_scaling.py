@@ -73,7 +73,8 @@ def main():
         "not per-dispatch tunnel latency (PROFILE.md)",
     )
     p.add_argument("--model", default="resnet20",
-                   help="resnet20 (cpu-friendly) or resnet56")
+                   help="resnet20 (cpu-friendly), resnet56, or mlp "
+                   "(near-zero compile — CI harness validation)")
     args = p.parse_args()
 
     if args.platform == "cpu":
@@ -94,8 +95,16 @@ def main():
     from fedml_tpu.core.client import make_client_optimizer, make_local_update
     from fedml_tpu.models import resnet as resnet_mod
 
-    image = 32 if args.model == "resnet56" else 16
-    bundle = getattr(resnet_mod, args.model)(num_classes=10, image_size=image)
+    if args.model == "mlp":
+        # 8x8 inputs through a small MLP: the harness logic (meshes,
+        # ladders, fused rounds, timing) without conv compile cost
+        from fedml_tpu.models.linear import mlp2
+
+        image = 8
+        bundle = mlp2(image * image * 3, 32, 10, input_shape=(image, image, 3))
+    else:
+        image = 32 if args.model == "resnet56" else 16
+        bundle = getattr(resnet_mod, args.model)(num_classes=10, image_size=image)
     opt = make_client_optimizer("sgd", 0.01, momentum=0.9)
     local_update = make_local_update(
         bundle, opt, epochs=1,

@@ -1,0 +1,132 @@
+"""North-star convergence trajectory on CIFAR-shaped synthetic data.
+
+VERDICT r1 #6b: commit accuracy-trajectory evidence toward the north
+star (CIFAR-10 + ResNet-56, non-IID LDA a=0.5, 87.12 @ 100 rounds —
+``/root/reference/benchmark/README.md:105``).  Real CIFAR-10 cannot be
+downloaded in this zero-egress environment, so this runs the EXACT
+north-star hyperparameters (10 clients all participating, LDA a=0.5,
+SGD lr 1e-3 wd 1e-3, E=20 local epochs, batch 64, 100 rounds — the
+reference's cross-silo benchmark row) on CIFAR-shaped synthetic data
+(50k train / 10k test, 32x32x3, 10 classes) and records the full
+trajectory to ``CONVERGENCE_r02.json``.
+
+The synthetic task's absolute accuracy is not comparable to real
+CIFAR-10; what the artifact certifies is that the full north-star
+configuration — model, partitioner, cohort, optimizer, mixed precision,
+100 federated rounds — runs end-to-end on the TPU chip and the global
+model's test accuracy climbs monotonically to near-ceiling.
+
+Usage: python tools/convergence_run.py [--rounds 100] [--out FILE]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--rounds", type=int, default=100)
+    p.add_argument("--num-train", type=int, default=50000)
+    p.add_argument("--num-test", type=int, default=10000)
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--eval-every", type=int, default=5)
+    p.add_argument("--out", default="CONVERGENCE_r02.json")
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig, FedAvgSimulation
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models.resnet import resnet56
+
+    cfg = FedAvgConfig(
+        num_clients=10,
+        clients_per_round=10,          # all participating (BASELINE.md)
+        comm_rounds=args.rounds,
+        epochs=args.epochs,            # E=20
+        batch_size=64,
+        client_optimizer="sgd",
+        lr=1e-3,
+        weight_decay=1e-3,
+        frequency_of_the_test=args.eval_every,
+        compute_dtype="bf16",
+        seed=0,
+    )
+    ds = synthetic_classification(
+        num_train=args.num_train,
+        num_test=args.num_test,
+        input_shape=(32, 32, 3),
+        num_classes=10,
+        num_clients=cfg.num_clients,
+        partition="hetero",            # LDA, alpha below
+        partition_alpha=0.5,
+        seed=0,
+        name="cifar10(synthetic-standin)",
+    )
+    sim = FedAvgSimulation(resnet56(num_classes=10), ds, cfg)
+
+    t0 = time.time()
+
+    def log_fn(m):
+        line = {k: round(v, 5) if isinstance(v, float) else v
+                for k, v in m.items()}
+        line["elapsed_s"] = round(time.time() - t0, 1)
+        print(json.dumps(line), flush=True)
+
+    hist = sim.run(log_fn=log_fn)
+
+    evals = [h for h in hist if "test_acc" in h]
+    artifact = {
+        "experiment": "north-star convergence (synthetic CIFAR-10 stand-in)",
+        "reference_target": {
+            "dataset": "CIFAR-10 (real, unavailable offline)",
+            "non_iid_acc": 87.12,
+            "rounds": 100,
+            "source": "/root/reference/benchmark/README.md:105",
+        },
+        "config": {
+            "model": "resnet56",
+            "clients": cfg.num_clients,
+            "clients_per_round": cfg.clients_per_round,
+            "partition": "LDA alpha=0.5",
+            "optimizer": "sgd",
+            "lr": cfg.lr,
+            "weight_decay": cfg.weight_decay,
+            "local_epochs": cfg.epochs,
+            "batch_size": cfg.batch_size,
+            "rounds": args.rounds,
+            "compute_dtype": "bf16",
+            "train_samples": args.num_train,
+            "test_samples": args.num_test,
+        },
+        "platform": jax.devices()[0].platform,
+        "wall_clock_s": round(time.time() - t0, 1),
+        "final_test_acc": evals[-1]["test_acc"] if evals else None,
+        "final_train_acc": hist[-1].get("train_acc"),
+        "trajectory": [
+            {
+                "round": h["round"],
+                "test_acc": round(h["test_acc"], 5),
+                "test_loss": round(h["test_loss"], 5),
+                "train_acc": round(h.get("train_acc", float("nan")), 5),
+            }
+            for h in evals
+        ],
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(f"wrote {args.out}: final_test_acc={artifact['final_test_acc']}")
+
+
+if __name__ == "__main__":
+    main()

@@ -292,14 +292,19 @@ class FedAvgSimulation:
         aggregate_transform: Optional[Callable] = None,
         local_update: Optional[LocalUpdateFn] = None,
         augment_fn: Optional[Callable] = None,
+        client_lr: Optional[Any] = None,
     ):
+        """``client_lr`` overrides ``config.lr`` for the client optimizer
+        and may be an optax schedule (count -> lr), e.g. FedNAS's
+        per-epoch cosine — every other config knob (prox_mu, grad_clip,
+        compute_dtype, augment_fn) keeps applying unchanged."""
         self.bundle = bundle
         self.dataset = dataset
         self.cfg = config
         self.loss_fn = loss_fn
         optimizer = make_client_optimizer(
             config.client_optimizer,
-            config.lr,
+            config.lr if client_lr is None else client_lr,
             momentum=config.momentum,
             weight_decay=config.weight_decay,
             grad_clip=config.grad_clip,

@@ -47,6 +47,7 @@ class FedNASConfig:
     epochs: int = 1               # local search epochs per round
     batch_size: int = 8
     lr: float = 0.025             # weight SGD (reference --learning_rate)
+    lr_min: float = 0.001         # cosine floor (reference --learning_rate_min)
     momentum: float = 0.9
     weight_decay: float = 3e-4    # reference --weight_decay
     grad_clip: float = 5.0        # reference --grad_clip
@@ -54,6 +55,27 @@ class FedNASConfig:
     arch_weight_decay: float = 1e-3
     lambda_train_regularizer: float = 1.0   # reference --lambda_train_regularizer
     seed: int = 0
+
+
+def cosine_epoch_schedule(lr: float, lr_min: float, epochs: int,
+                          steps_per_epoch: int):
+    """The reference's weight-LR schedule, exactly: a FRESH
+    ``CosineAnnealingLR(T_max=epochs, eta_min=learning_rate_min)`` per
+    round, stepped once per local EPOCH (``FedNASTrainer.py:52-72``) —
+    the LR is constant within an epoch, and the optimizer state (and
+    hence the step count) resets every round, so the two schedules
+    align: ``lr_e = lr_min + (lr - lr_min) (1 + cos(pi e / E)) / 2``.
+    """
+    if epochs <= 1:
+        return lr  # the scheduler never steps within a 1-epoch session
+
+    def schedule(count):
+        epoch = jnp.minimum(count // steps_per_epoch, epochs)
+        return lr_min + 0.5 * (lr - lr_min) * (
+            1.0 + jnp.cos(jnp.pi * epoch / epochs)
+        )
+
+    return schedule
 
 
 class SearchState(NamedTuple):
@@ -94,7 +116,9 @@ class FedNASSearch:
         cfg = self.cfg
         bundle = self.bundle
         w_opt = make_client_optimizer(
-            "sgd", cfg.lr, momentum=cfg.momentum,
+            "sgd", cosine_epoch_schedule(cfg.lr, cfg.lr_min, cfg.epochs,
+                                         self.steps),
+            momentum=cfg.momentum,
             weight_decay=cfg.weight_decay, grad_clip=cfg.grad_clip,
         )
         # reference Architect: Adam(arch_lr, betas=(0.5, 0.999), wd)
@@ -272,9 +296,24 @@ class FedNASSearch:
 def fednas_train_stage(
     genotype: Genotype, dataset: FedDataset, config: FedAvgConfig,
     *, C: int = 36, layers: int = 20, image_size: int = 32,
+    in_channels: int = 3, lr_min: float = 0.001,
 ) -> FedAvgSimulation:
     """Stage 2 (``--stage train``): plain federated training of the fixed
-    network — the FedAvg engine on the derived genotype."""
+    network — the FedAvg engine on the derived genotype.
+
+    The reference's train stage uses the SAME fresh per-round
+    ``CosineAnnealingLR(T_max=epochs, eta_min=learning_rate_min)`` as the
+    search stage (``FedNASTrainer.py:141-155``), so the weight optimizer
+    gets the per-epoch cosine schedule here too (constant lr when
+    epochs == 1, where the reference scheduler never steps).
+    """
     bundle = darts_network(genotype, C=C, num_classes=dataset.num_classes,
-                           layers=layers, image_size=image_size)
-    return FedAvgSimulation(bundle, dataset, config)
+                           layers=layers, image_size=image_size,
+                           in_channels=in_channels)
+    schedule = cosine_epoch_schedule(
+        config.lr, lr_min, config.epochs,
+        cohort_steps_per_epoch(dataset, config.batch_size),
+    )
+    # client_lr override: every other FedAvgConfig knob (prox_mu,
+    # grad_clip, compute_dtype, ...) keeps applying
+    return FedAvgSimulation(bundle, dataset, config, client_lr=schedule)

@@ -94,7 +94,9 @@ def make_client_optimizer(
         if wd:
             chain.append(optax.add_decayed_weights(wd))
         chain.append(_scale_by_amsgrad_torch())
-        chain.append(optax.scale(-lr))
+        # scale_by_learning_rate = scale(-lr), and also accepts an optax
+        # schedule (count -> lr) like the sgd branch does
+        chain.append(optax.scale_by_learning_rate(lr))
     else:
         raise ValueError(f"unknown client optimizer: {name}")
     return optax.chain(*chain)

@@ -69,6 +69,7 @@ class ExperimentConfig:
     # fednas
     stage: str = "search"
     arch_lr: float = 3e-4
+    lr_min: float = 0.001  # cosine weight-LR floor (--learning_rate_min)
     lambda_train_regularizer: float = 1.0
     # fedgkt
     temperature: float = 3.0
@@ -304,13 +305,14 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         from fedml_tpu.models.darts.search import darts_search
 
         img = ds.train_x.shape[1]
+        chans = int(ds.train_x.shape[-1])
         search = FedNASSearch(
             darts_search(C=8, num_classes=ds.num_classes, layers=4,
-                         image_size=img),
+                         image_size=img, in_channels=chans),
             ds, FedNASConfig(
                 num_clients=ds.num_clients, comm_rounds=cfg.comm_round,
                 epochs=cfg.epochs, batch_size=cfg.batch_size, lr=cfg.lr,
-                arch_lr=cfg.arch_lr,
+                lr_min=cfg.lr_min, arch_lr=cfg.arch_lr,
                 lambda_train_regularizer=cfg.lambda_train_regularizer,
                 seed=cfg.seed,
             ))
@@ -319,12 +321,17 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         out = {"history": hist, "genotype": str(genotype),
                "wall_s": time.time() - t0}
         if cfg.stage == "train":
+            # reference train stage: SGD(momentum, wd) + grad clip
+            # (FedNASTrainer.py:134-141,185) — same knobs as search
             sim = fednas_train_stage(genotype, ds, FedAvgConfig(
                 num_clients=ds.num_clients,
                 clients_per_round=cfg.client_num_per_round,
                 comm_rounds=cfg.comm_round, epochs=cfg.epochs,
                 batch_size=cfg.batch_size, lr=cfg.lr, seed=cfg.seed,
-            ), C=8, layers=4, image_size=img)
+                momentum=cfg.momentum or 0.9, weight_decay=cfg.wd,
+                grad_clip=5.0,
+            ), C=8, layers=4, image_size=img, in_channels=chans,
+                lr_min=cfg.lr_min)
             out["train_history"] = sim.run(log_fn=log_fn)
         return out
 

@@ -79,11 +79,11 @@ class NetworkCIFAR(nn.Module):
 
 
 def darts_network(genotype: Genotype, C=36, num_classes=10, layers=20,
-                  image_size=32) -> ModelBundle:
+                  image_size=32, in_channels=3) -> ModelBundle:
     """Reference factory ``NetworkCIFAR(C, num_classes, layers, auxiliary,
     genotype)`` (``model.py:111-160``)."""
     return ModelBundle(
         module=NetworkCIFAR(genotype=genotype, C=C, num_classes=num_classes,
                             layers=layers),
-        input_shape=(image_size, image_size, 3),
+        input_shape=(image_size, image_size, in_channels),
     )

@@ -164,11 +164,11 @@ class SearchBundle:
 
 
 def darts_search(C=16, num_classes=10, layers=8, image_size=32,
-                 steps=4, multiplier=4) -> SearchBundle:
+                 steps=4, multiplier=4, in_channels=3) -> SearchBundle:
     """Reference factory ``Network(C, num_classes, layers, ...)``
     (``model_search.py:174``)."""
     return SearchBundle(
         module=SearchNetwork(C=C, num_classes=num_classes, layers=layers,
                              steps=steps, multiplier=multiplier),
-        input_shape=(image_size, image_size, 3),
+        input_shape=(image_size, image_size, in_channels),
     )

@@ -20,6 +20,7 @@ def _tiny_ds(seed=0):
     )
 
 
+@pytest.mark.slow  # forward is exercised inside the default-tier search-round test
 def test_search_network_forward():
     # steps=2 (5 edges/cell instead of 14): same machinery, ~3x less XLA
     # compile on this 1-core box; full-size search runs in the slow tier
@@ -90,3 +91,23 @@ def test_fednas_train_stage_runs_fixed_network():
     hist = sim.run()
     assert np.isfinite(hist[-1]["train_loss"])
     assert "test_acc" in hist[-1]
+
+
+def test_darts_constructors_honor_in_channels():
+    """1-channel datasets (MNIST-shaped) must work through both stages:
+    the CLI path derives in_channels from the dataset (run.py). Shape-
+    level check via eval_shape — no XLA compile."""
+    from fedml_tpu.models.darts.genotypes import DARTS_V2
+    from fedml_tpu.models.darts.network import darts_network
+
+    b = darts_search(C=4, num_classes=3, layers=2, image_size=8, steps=2,
+                     multiplier=2, in_channels=1)
+    shapes = jax.eval_shape(b.init, jax.random.PRNGKey(0))
+    stem = shapes["params"]["Conv_0"]["kernel"]
+    assert stem.shape[2] == 1  # stem consumes 1 input channel
+
+    nb = darts_network(DARTS_V2, C=4, layers=2, image_size=8, in_channels=1)
+    nshapes = jax.eval_shape(nb.init, jax.random.PRNGKey(0))
+    nstem = jax.tree_util.tree_leaves(nshapes["params"])[0]
+    assert b.input_shape[-1] == nb.input_shape[-1] == 1
+    del nstem

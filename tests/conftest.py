@@ -20,9 +20,12 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_default_matmul_precision", "highest")
-# persistent compile cache: the suite is compile-bound on the CPU mesh
+# persistent compile cache: the suite is compile-bound on the CPU mesh.
+# Threshold 0: the cache is keyed by HLO hash, so identical programs
+# compiled by DIFFERENT jit closures across test modules dedupe even
+# within one cold run.
 jax.config.update("jax_compilation_cache_dir", "/tmp/jax_test_cache")
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
 
 assert jax.device_count() >= 8, (
     "test harness expected a faked 8-device CPU mesh; got "

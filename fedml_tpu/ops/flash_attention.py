@@ -32,8 +32,8 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
-                  *, sm_scale: float, causal: bool, block_q: int,
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *, sm_scale: float, causal: bool, block_q: int,
                   block_k: int):
     qi = pl.program_id(0)
     ki = pl.program_id(1)
@@ -87,6 +87,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr,
         o_ref[:] = (acc_scr[:] / jnp.maximum(l_scr[:, :1], 1e-30)).astype(
             o_ref.dtype
         )
+        # log-sum-exp per query row — the softmax statistic the custom
+        # backward needs to recompute p without re-running the online max
+        lse_ref[:] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
 
 
 def _flash_single(q, k, v, *, causal, block_q, block_k, interpret):
@@ -117,7 +120,7 @@ def _flash_single(q, k, v, *, causal, block_q, block_k, interpret):
         kwargs["compiler_params"] = pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary"),
         )
-    return pl.pallas_call(
+    out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
@@ -125,12 +128,112 @@ def _flash_single(q, k, v, *, causal, block_q, block_k, interpret):
             pl.BlockSpec((block_k, D), lambda qi, ki: (ki, 0)),
             pl.BlockSpec((block_k, D), lambda qi, ki: (ki, 0)),
         ],
-        out_specs=pl.BlockSpec((block_q, D), lambda qi, ki: (qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((Lq, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((block_q, D), lambda qi, ki: (qi, 0)),
+            # lse rows replicated across the 128 lanes of the m/l scratch
+            pl.BlockSpec((block_q, 128), lambda qi, ki: (qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((Lq, D), q.dtype),
+            jax.ShapeDtypeStruct((Lq, 128), jnp.float32),
+        ],
         scratch_shapes=scratch_shapes,
         interpret=interpret,
         **kwargs,
     )(q, k, v)
+    return out, lse[:, 0]
+
+
+def _flash_bwd_single(q, k, v, o, lse, do, *, causal, block_k, sm_scale):
+    """Exact flash backward for one [L, D] head slice in KV blocks —
+    O(L) memory (no [L, L] residuals; p is recomputed per block
+    pair from the forward's saved log-sum-exp).  Standard formulas:
+
+        p_ij  = exp(s_ij - lse_i)
+        dv_j  = pᵀ dO           dp_ij = dO_i · v_j
+        ds_ij = p_ij (dp_ij - D_i),   D_i = dO_i · O_i
+        dq_i  = scale · Σ_j ds_ij k_j
+        dk_j  = scale · Σ_i ds_ij q_i
+
+    Causal blocks above the diagonal DO run their (zero-producing)
+    matmuls here, unlike the forward kernel's block skip — a version
+    that bounded a fori_loop to each q block's visible KV prefix was
+    tried and measured ~6x SLOWER (313 ms vs 52 ms at L=4096): the
+    per-iteration dynamic_update_slice of the full [Lk, D] dk/dv
+    accumulators inside a while carry costs far more than the skipped
+    matmuls save.  The straight KV scan below emits dk/dv as stacked
+    scan outputs instead, which XLA handles well.
+    """
+    L, Dm = q.shape
+    Lk = k.shape[0]
+    bs = min(block_k, Lk)
+    n_blocks = Lk // bs
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    Drow = (dof * o.astype(jnp.float32)).sum(-1)        # [L]
+    qpos = jnp.arange(L)
+
+    def body(dq, j):
+        kb = jax.lax.dynamic_slice_in_dim(k, j * bs, bs).astype(jnp.float32)
+        vb = jax.lax.dynamic_slice_in_dim(v, j * bs, bs).astype(jnp.float32)
+        s = (qf @ kb.T) * sm_scale                      # [L, bs]
+        if causal:
+            kpos = j * bs + jnp.arange(bs)
+            s = jnp.where(kpos[None, :] <= qpos[:, None], s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                   # [L, bs]
+        dv_j = p.T @ dof                                # [bs, D]
+        dp = dof @ vb.T                                 # [L, bs]
+        ds = p * (dp - Drow[:, None])
+        dq = dq + (ds @ kb) * sm_scale
+        dk_j = (ds.T @ qf) * sm_scale                   # [bs, D]
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros((L, Dm), jnp.float32)
+    dq, (dks, dvs) = jax.lax.scan(body, dq0, jnp.arange(n_blocks))
+    dk = dks.reshape(Lk, Dm)
+    dv = dvs.reshape(Lk, Dm)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _flash_heads(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_heads(q, k, v, causal, block_q, block_k, interpret):
+    run = functools.partial(
+        _flash_single, causal=causal, block_q=block_q, block_k=block_k,
+        interpret=interpret,
+    )
+    # vmap over a LEADING head axis: pallas prepends the batch dim to the
+    # grid, keeping each block's trailing dims tile-aligned ([L, D])
+    qh, kh, vh = (t.swapaxes(0, 1) for t in (q, k, v))
+    out, lse = jax.vmap(run)(qh, kh, vh)
+    return out.swapaxes(0, 1), lse  # out [L, H, D], lse [H, L]
+
+
+def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _flash_heads(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd(causal, block_q, block_k, interpret, res, g):
+    del block_q, interpret
+    q, k, v, out, lse = res
+    sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    run = functools.partial(
+        _flash_bwd_single, causal=causal, block_k=block_k,
+        sm_scale=sm_scale,
+    )
+    swap = lambda t: t.swapaxes(0, 1)  # noqa: E731
+    dq, dk, dv = jax.vmap(run)(
+        swap(q), swap(k), swap(v), swap(out), lse, swap(g)
+    )
+    return swap(dq), swap(dk), swap(dv)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(
@@ -144,17 +247,13 @@ def flash_attention(
     """Flash attention over [L, H, D] (no batch; vmap for batches).
 
     Drop-in for ``parallel.ring_attention.blockwise_attention`` where
-    shapes divide the block sizes.
+    shapes divide the block sizes.  DIFFERENTIABLE: the custom backward
+    recomputes p per KV block from the kernel's saved log-sum-exp — an
+    exact O(L)-memory gradient, so the training path never materializes
+    [L, L] (tests/test_flash_attention.py pins grads against dense
+    attention).
     """
-    run = functools.partial(
-        _flash_single, causal=causal, block_q=block_q, block_k=block_k,
-        interpret=interpret,
-    )
-    # vmap over a LEADING head axis: pallas prepends the batch dim to the
-    # grid, keeping each block's trailing dims tile-aligned ([L, D])
-    qh, kh, vh = (t.swapaxes(0, 1) for t in (q, k, v))
-    out = jax.vmap(run)(qh, kh, vh)
-    return out.swapaxes(0, 1)
+    return _flash(q, k, v, causal, block_q, block_k, interpret)
 
 
 def flash_attn_fn(block_q: int = 128, block_k: int = 128,
@@ -166,3 +265,23 @@ def flash_attn_fn(block_q: int = 128, block_k: int = 128,
                                block_k=block_k, interpret=interpret)
 
     return attn
+
+
+def pick_block(length: int, preferred: int = 1024) -> int:
+    """Largest power-of-two block <= preferred that divides ``length``
+    (0 if none >= 128 divides it — caller should fall back to the lax
+    blockwise path).
+
+    Measured on one v5e chip (bf16, B=4 H=8 D=64, dispatch amortized by
+    a fused 50-iteration scan): 1024-blocks run 4.4/5.0/9.7 ms per call
+    at L=1k/4k/8k vs 4.4/9.0/23.1 ms for the XLA blockwise scan — parity
+    at 1k, 2.4x at 8k.  SMALL blocks are actively bad on TPU (256-blocks
+    measured 4-8x slower than 1024): the (q, kv) grid then has too many
+    tiny kernel invocations for the scalar core to schedule.
+    """
+    b = preferred
+    while b >= 128:
+        if length % b == 0:
+            return b
+        b //= 2
+    return 0

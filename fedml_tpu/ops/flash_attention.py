@@ -144,7 +144,8 @@ def _flash_single(q, k, v, *, causal, block_q, block_k, interpret):
     return out, lse[:, 0]
 
 
-def _flash_bwd_single(q, k, v, o, lse, do, *, causal, block_k, sm_scale):
+def _flash_bwd_single(q, k, v, o, lse, do, dlse, *, causal, block_k,
+                      sm_scale):
     """Exact flash backward for one [L, D] head slice in KV blocks —
     O(L) memory (no [L, L] residuals; p is recomputed per block
     pair from the forward's saved log-sum-exp).  Standard formulas:
@@ -183,7 +184,9 @@ def _flash_bwd_single(q, k, v, o, lse, do, *, causal, block_k, sm_scale):
         p = jnp.exp(s - lse[:, None])                   # [L, bs]
         dv_j = p.T @ dof                                # [bs, D]
         dp = dof @ vb.T                                 # [L, bs]
-        ds = p * (dp - Drow[:, None])
+        # dlse: the lse OUTPUT's cotangent (nonzero when the caller uses
+        # lse, e.g. the ring merge weights) — d lse_i / d s_ij = p_ij
+        ds = p * (dp - Drow[:, None] + dlse[:, None])
         dq = dq + (ds @ kb) * sm_scale
         dk_j = (ds.T @ qf) * sm_scale                   # [bs, D]
         return dq, (dk_j, dv_j)
@@ -195,13 +198,7 @@ def _flash_bwd_single(q, k, v, o, lse, do, *, causal, block_k, sm_scale):
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _flash_heads(q, k, v, causal, block_q, block_k, interpret)
-    return out
-
-
-def _flash_heads(q, k, v, causal, block_q, block_k, interpret):
+def _flash_heads_impl(q, k, v, causal, block_q, block_k, interpret):
     run = functools.partial(
         _flash_single, causal=causal, block_q=block_q, block_k=block_k,
         interpret=interpret,
@@ -213,14 +210,23 @@ def _flash_heads(q, k, v, causal, block_q, block_k, interpret):
     return out.swapaxes(0, 1), lse  # out [L, H, D], lse [H, L]
 
 
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention_with_lse(q, k, v, causal, block_q, block_k, interpret):
+    """Differentiable (o, lse) pair — the ring path consumes BOTH (the
+    merge weights are lse functions), so the backward carries the lse
+    cotangent too (one extra ``p * dlse`` term in ds)."""
+    return _flash_heads_impl(q, k, v, causal, block_q, block_k, interpret)
+
+
 def _flash_fwd(q, k, v, causal, block_q, block_k, interpret):
-    out, lse = _flash_heads(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+    out, lse = _flash_heads_impl(q, k, v, causal, block_q, block_k, interpret)
+    return (out, lse), (q, k, v, out, lse)
 
 
 def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     del block_q, interpret
     q, k, v, out, lse = res
+    do, dlse = g
     sm_scale = 1.0 / (q.shape[-1] ** 0.5)
     run = functools.partial(
         _flash_bwd_single, causal=causal, block_k=block_k,
@@ -228,12 +234,13 @@ def _flash_bwd(causal, block_q, block_k, interpret, res, g):
     )
     swap = lambda t: t.swapaxes(0, 1)  # noqa: E731
     dq, dk, dv = jax.vmap(run)(
-        swap(q), swap(k), swap(v), swap(out), lse, swap(g)
+        swap(q), swap(k), swap(v), swap(out), lse, swap(do),
+        dlse.astype(jnp.float32),
     )
     return swap(dq), swap(dk), swap(dv)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+flash_attention_with_lse.defvjp(_flash_fwd, _flash_bwd)
 
 
 def flash_attention(
@@ -253,7 +260,10 @@ def flash_attention(
     [L, L] (tests/test_flash_attention.py pins grads against dense
     attention).
     """
-    return _flash(q, k, v, causal, block_q, block_k, interpret)
+    out, _ = flash_attention_with_lse(
+        q, k, v, causal, block_q, block_k, interpret
+    )
+    return out
 
 
 def flash_attn_fn(block_q: int = 128, block_k: int = 128,

@@ -22,6 +22,8 @@ attention with K/V blocks rotating around the ICI ring):
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -171,6 +173,86 @@ def ring_attention(
         step, (*state, k, v), jnp.arange(1, axis_size)
     )
     return _normalize(m, l, o)
+
+
+def ring_flash_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    block: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ring attention whose per-step local attention is the pallas flash
+    kernel (``ops/flash_attention.py``) instead of the lax blockwise scan
+    — same rotation schedule and exact math, ~2x the per-step attention
+    rate at long shard lengths on TPU.
+
+    The cross-shard structure removes the need for global positions
+    inside the kernel: under causal masking a source shard from an
+    EARLIER ring rank is fully visible to every local query (non-causal
+    step), a LATER rank contributes nothing (its lse is forced to -inf
+    before the merge, costing one wasted kernel run the SPMD lockstep
+    requires anyway — exactly like the lax path's fully-masked steps),
+    and only the resident step is causal.  Per-source normalized outputs
+    merge by log-sum-exp weights:
+
+        m = max(lse_a, lse_b);  w_s = exp(lse_s - m)
+        o = (w_a o_a + w_b o_b) / (w_a + w_b);  lse = m + log(w_a + w_b)
+
+    Equivalence with the lax ring and dense attention is pinned in
+    interpret mode (``tests/test_ring_attention.py``); default ``block``
+    is ``pick_block`` of the shard length.
+    """
+    from fedml_tpu.ops.flash_attention import (
+        flash_attention_with_lse,
+        pick_block,
+    )
+
+    axis_size = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    L = q.shape[0]
+    b = block or pick_block(L)
+    if not b:
+        raise ValueError(
+            f"shard length {L} has no >=128 power-of-two block; use the "
+            "lax ring_attention"
+        )
+
+    def flash(qq, kk, vv, c):
+        # the custom_vjp pair: differentiable through BOTH o and lse
+        # (the merge weights below are lse functions)
+        o, lse = flash_attention_with_lse(qq, kk, vv, c, b, b, interpret)
+        return o.astype(jnp.float32), lse  # o [L, H, D], lse [H, L]
+
+    # step 0: the resident shard (the only causal step)
+    o, lse = flash(q, k, v, causal)
+
+    perm = [(i, (i - 1) % axis_size) for i in range(axis_size)]
+
+    def step(carry, i):
+        o, lse, kc, vc = carry
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        src = (my_idx + i) % axis_size
+        o_s, lse_s = flash(q, kc, vc, False)
+        if causal:
+            # later ranks' keys are all in this query shard's future
+            lse_s = jnp.where(src < my_idx, lse_s, NEG_INF)
+        m = jnp.maximum(lse, lse_s)
+        wa = jnp.exp(lse - m)                       # [H, L]
+        wb = jnp.exp(lse_s - m)
+        den = jnp.maximum(wa + wb, 1e-30)
+        waT = (wa / den).T[:, :, None]              # [L, H, 1]
+        wbT = (wb / den).T[:, :, None]
+        o = waT * o + wbT * o_s
+        lse = m + jnp.log(den)
+        return (o, lse, kc, vc), None
+
+    (o, lse, _, _), _ = lax.scan(
+        step, (o, lse, k, v), jnp.arange(1, axis_size)
+    )
+    return o.astype(q.dtype)
 
 
 def dense_attention(q, k, v, *, causal: bool = False) -> jax.Array:

@@ -24,7 +24,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 shard_map = jax.shard_map
 
 from fedml_tpu.models.transformer import TransformerLM
-from fedml_tpu.parallel.ring_attention import ring_attention
+from fedml_tpu.parallel.ring_attention import (ring_attention,
+                                               ring_flash_attention)
 
 PyTree = Any
 
@@ -46,6 +47,9 @@ def sequence_parallel_lm(
     max_len: int = 2048,
     block_size: int = 512,
     axis: str = "sp",
+    attn_impl: str = "lax",
+    flash_block: Optional[int] = None,
+    flash_interpret: bool = False,
 ):
     """Build (module, init, apply) where ``apply(variables, tokens)``
     runs the forward with the sequence dim sharded over ``axis``.
@@ -53,11 +57,25 @@ def sequence_parallel_lm(
     tokens: [B, L] with L divisible by the axis size.  Returns logits
     [B, L, V] (reassembled from shards by shard_map's out_spec).
     """
+    if attn_impl not in ("lax", "flash"):
+        raise ValueError(
+            f"attn_impl must be 'lax' or 'flash', got {attn_impl!r}"
+        )
     module = TransformerLM(
         vocab_size=vocab_size, embed_dim=embed_dim, num_heads=num_heads,
         num_layers=num_layers, max_len=max_len,
-        attn_fn=lambda q, k, v, causal: ring_attention(
-            q, k, v, axis, causal=causal, block_size=block_size
+        # "flash": the pallas-kernel ring path (ring_flash_attention) —
+        # ~2x per-step attention at long shard lengths on TPU pods;
+        # "lax" (default) is the portable blockwise ring.  flash_block
+        # overrides pick_block; flash_interpret runs the kernel's CPU
+        # interpreter (tests on the faked mesh).
+        attn_fn=(
+            (lambda q, k, v, causal: ring_flash_attention(
+                q, k, v, axis, causal=causal, block=flash_block,
+                interpret=flash_interpret))
+            if attn_impl == "flash"
+            else (lambda q, k, v, causal: ring_attention(
+                q, k, v, axis, causal=causal, block_size=block_size))
         ),
         pos_offset_fn=lambda L: lax.axis_index(axis) * L,
     )
@@ -75,10 +93,16 @@ def sequence_parallel_lm(
     def _local_forward(variables, tokens):
         return module.apply(variables, tokens, train=False)
 
+    # check_vma only off for the flash path: pallas_call carries no vma
+    # metadata on its out_shape under JAX 0.9's typed varying axes.  The
+    # lax ring KEEPS the check — its carry inits were explicitly written
+    # to satisfy vma typing (ring_attention.py), and the trace-time type
+    # error is the guard against regressing that.
     sharded = shard_map(
         _local_forward, mesh=mesh,
         in_specs=(P(), P(None, axis)),
         out_specs=P(None, axis, None),
+        check_vma=(attn_impl != "flash"),
     )
 
     def apply(variables, tokens):

@@ -61,6 +61,14 @@ def sequence_parallel_lm(
         raise ValueError(
             f"attn_impl must be 'lax' or 'flash', got {attn_impl!r}"
         )
+    if attn_impl == "flash" and block_size != 512:
+        # block_size tunes the LAX ring's KV chunking; the flash path's
+        # pallas block is flash_block (pick_block default).  Reject the
+        # silent-ignore trap instead of guessing which one was meant.
+        raise ValueError(
+            "block_size applies to attn_impl='lax' only; tune the flash "
+            "path with flash_block"
+        )
     module = TransformerLM(
         vocab_size=vocab_size, embed_dim=embed_dim, num_heads=num_heads,
         num_layers=num_layers, max_len=max_len,

@@ -190,8 +190,8 @@ def test_sequence_parallel_lm_flash_impl():
 
     mesh = make_sequence_mesh(4)
     kwargs = dict(vocab_size=32, embed_dim=16, num_heads=2, num_layers=1,
-                  max_len=64, block_size=8)
-    _, init, apply_lax = sequence_parallel_lm(mesh, **kwargs)
+                  max_len=64)
+    _, init, apply_lax = sequence_parallel_lm(mesh, **kwargs, block_size=8)
     _, _, apply_flash = sequence_parallel_lm(
         mesh, **kwargs, attn_impl="flash", flash_block=8,
         flash_interpret=True,
@@ -204,3 +204,5 @@ def test_sequence_parallel_lm_flash_impl():
     )
     with pytest.raises(ValueError):
         sequence_parallel_lm(mesh, **kwargs, attn_impl="pallas")
+    with pytest.raises(ValueError):  # block_size is a lax-path knob
+        sequence_parallel_lm(mesh, **kwargs, attn_impl="flash", block_size=8)

@@ -80,6 +80,7 @@ class ExperimentConfig:
     num_heads: int = 4
     num_layers: int = 2
     tp_degree: int = 1  # >1: DP x TP on a (clients, model) device mesh
+    sp_degree: int = 1  # >1: DP x SP — long-context clients, ring attention
     # beyond-reference knobs available on the FedAvg-engine family
     compute_dtype: str = ""  # "bf16" = mixed-precision local training
     drop_prob: float = 0.0  # failure injection: P(client dies mid-round)
@@ -112,10 +113,16 @@ def _apply_ci(cfg: ExperimentConfig) -> ExperimentConfig:
 
 def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
     """Federated transformer fine-tuning over token sequences (the
-    long-context family the reference lacks).  ``tp_degree == 1`` uses
-    the standard simulation driver; ``tp_degree > 1`` runs the DP x TP
-    round on a (clients, model) mesh (``parallel/gspmd.py``) with the
-    transformer Megatron-sharded inside every client."""
+    long-context family the reference lacks).  Three drivers:
+
+    - ``tp_degree == sp_degree == 1``: the standard simulation driver;
+    - ``tp_degree > 1``: DP x TP on a (clients, model) mesh
+      (``parallel/gspmd.py``), transformer Megatron-sharded inside
+      every client;
+    - ``sp_degree > 1``: DP x SP on a (clients, sp) mesh
+      (``parallel/dp_sp.py``), each client's sequences sharded with
+      ring attention — federated long-context fine-tuning.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -129,7 +136,7 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
         num_layers=cfg.num_layers, seq_len=seq_len,
     )
 
-    if cfg.tp_degree <= 1:
+    if cfg.tp_degree <= 1 and cfg.sp_degree <= 1:
         from fedml_tpu.algorithms.fedavg import FedAvgConfig, FedAvgSimulation
 
         sim = FedAvgSimulation(bundle, ds, FedAvgConfig(
@@ -146,49 +153,83 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
         return {"history": hist, "final": hist[-1],
                 "wall_s": time.time() - t0}
 
-    from fedml_tpu.algorithms.fedavg import ServerState
+    from fedml_tpu.algorithms.fedavg import ServerState, resolve_compute_dtype
     from fedml_tpu.core.client import make_client_optimizer, make_local_update
     from fedml_tpu.core.types import pack_clients
-    from fedml_tpu.parallel.gspmd import make_dp_tp_mesh, make_dp_tp_round_fn
 
-    K = min(cfg.client_num_per_round, ds.num_clients)
-    if jax.device_count() % cfg.tp_degree:
+    if cfg.tp_degree > 1 and cfg.sp_degree > 1:
         raise ValueError(
-            f"tp_degree {cfg.tp_degree} does not divide device count "
+            "tp_degree and sp_degree cannot both exceed 1 (a 3-D "
+            "clients x model x sp mesh is not wired up)"
+        )
+    K = min(cfg.client_num_per_round, ds.num_clients)
+    degree = cfg.tp_degree if cfg.tp_degree > 1 else cfg.sp_degree
+    if jax.device_count() % degree:
+        raise ValueError(
+            f"parallel degree {degree} does not divide device count "
             f"{jax.device_count()}"
         )
-    dp = jax.device_count() // cfg.tp_degree
+    dp = jax.device_count() // degree
     if K % dp:
         raise ValueError(f"cohort {K} not divisible by dp width {dp}")
-    mesh = make_dp_tp_mesh(dp, cfg.tp_degree)
-    from fedml_tpu.algorithms.fedavg import resolve_compute_dtype
-
     opt = make_client_optimizer(
         cfg.client_optimizer, cfg.lr, momentum=cfg.momentum,
         weight_decay=cfg.wd,
     )
-    lu = make_local_update(
-        bundle, opt, epochs=cfg.epochs,
-        compute_dtype=resolve_compute_dtype(cfg.compute_dtype or None),
-    )
+    cdtype = resolve_compute_dtype(cfg.compute_dtype or None)
     key = jax.random.PRNGKey(cfg.seed)
-    state = ServerState(
-        variables=bundle.init(key), opt_state=(),
-        round_idx=jnp.zeros((), jnp.int32), key=key,
-    )
-    round_fn, shard_state, shard_data = make_dp_tp_round_fn(
-        mesh, lu, state.variables
-    )
-    state = shard_state(state)
+
+    if cfg.tp_degree > 1:
+        from fedml_tpu.parallel.gspmd import (
+            make_dp_tp_mesh, make_dp_tp_round_fn,
+        )
+
+        mesh = make_dp_tp_mesh(dp, cfg.tp_degree)
+        lu = make_local_update(
+            bundle, opt, epochs=cfg.epochs, compute_dtype=cdtype,
+        )
+        state = ServerState(
+            variables=bundle.init(key), opt_state=(),
+            round_idx=jnp.zeros((), jnp.int32), key=key,
+        )
+        round_fn, shard_state, shard_data = make_dp_tp_round_fn(
+            mesh, lu, state.variables
+        )
+        state = shard_state(state)
+    else:
+        # DP x SP: each client's sequences sharded over an sp axis with
+        # ring attention — federated LONG-CONTEXT fine-tuning
+        # (parallel/dp_sp.py; parity vs single-device in tests/test_dp_sp.py)
+        from fedml_tpu.parallel.dp_sp import (
+            make_dp_sp_mesh, make_dp_sp_round_fn,
+        )
+
+        if seq_len % cfg.sp_degree:
+            raise ValueError(
+                f"sequence length {seq_len} not divisible by sp_degree "
+                f"{cfg.sp_degree}"
+            )
+        mesh = make_dp_sp_mesh(dp, cfg.sp_degree)
+        round_fn, shard_data, init_fn = make_dp_sp_round_fn(
+            mesh, vocab_size=vocab, embed_dim=cfg.embed_dim,
+            num_heads=cfg.num_heads, num_layers=cfg.num_layers,
+            max_len=seq_len, optimizer=opt, epochs=cfg.epochs,
+            compute_dtype=cdtype,
+            block_size=max(1, min(512, seq_len // cfg.sp_degree)),
+        )
+        state = ServerState(
+            variables=init_fn(key), opt_state=(),
+            round_idx=jnp.zeros((), jnp.int32), key=key,
+        )
     hist = []
     from fedml_tpu.core.types import cohort_steps_per_epoch
 
     steps = cohort_steps_per_epoch(ds, cfg.batch_size)
     from fedml_tpu.core.sampling import host_sample_ids
 
-    # same evaluator + cadence as the tp_degree==1 simulation driver, so
-    # the two paths stay comparable (jit runs the fp32 eval forward with
-    # the TP-sharded variables in place — no gather needed)
+    # same evaluator + cadence as the simulation driver, so all fedllm
+    # paths stay comparable (jit runs the fp32 eval forward with the
+    # TP-sharded or replicated variables in place — no gather needed)
     from fedml_tpu.core.client import eval_summary, make_evaluator
     from fedml_tpu.core.types import batch_eval_pack
 
@@ -201,7 +242,7 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
         ))
 
     for r in range(cfg.comm_round):
-        # shared sampler: tp_degree=1 and >1 runs are cohort-comparable
+        # shared sampler: all fedllm paths are cohort-comparable
         ids = host_sample_ids(cfg.seed, r, ds.num_clients, K)
         # round-independent pack seed: same convention as the simulation
         # and cross-device drivers (the local update re-permutes per

@@ -93,3 +93,31 @@ def test_run_experiment_fedllm_and_dp_tp():
     assert np.isfinite(out["final"]["test_acc"])
     assert np.isfinite(out2["final"]["test_acc"])
     assert np.isfinite(out2["final"]["test_loss"])
+
+
+def test_run_experiment_fedllm_dp_sp():
+    """DP x SP fedllm path: 2-way DP x 4-way SP over the faked 8-device
+    mesh — federated long-context fine-tuning from the CLI config."""
+    import numpy as np
+
+    from fedml_tpu.experiments.run import ExperimentConfig, run_experiment
+
+    out = run_experiment(ExperimentConfig(
+        algorithm="fedllm", dataset="fed_shakespeare", comm_round=2,
+        client_num_in_total=4, client_num_per_round=4, batch_size=4,
+        embed_dim=32, num_heads=4, num_layers=1, lr=0.1, sp_degree=4,
+    ), log_fn=None)
+    assert len(out["history"]) == 2
+    assert "mesh" in out
+    assert np.isfinite(out["history"][-1]["loss_sum"])
+    assert np.isfinite(out["final"]["test_acc"])
+
+    import pytest
+
+    with pytest.raises(ValueError):
+        run_experiment(ExperimentConfig(
+            algorithm="fedllm", dataset="fed_shakespeare", comm_round=1,
+            client_num_in_total=4, client_num_per_round=4, batch_size=4,
+            embed_dim=32, num_heads=4, num_layers=1, tp_degree=2,
+            sp_degree=2,
+        ), log_fn=None)

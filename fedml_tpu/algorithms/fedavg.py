@@ -35,6 +35,7 @@ from fedml_tpu.core.types import (
     FedDataset,
     batch_eval_pack,
     cohort_steps_per_epoch,
+    device_resident_pack,
     pack_clients,
 )
 from fedml_tpu.models.base import ModelBundle
@@ -384,27 +385,10 @@ class FedAvgSimulation:
         key = tuple(int(i) for i in ids)
         if self._pack_cache is not None and self._pack_cache[0] == key:
             return self._pack_cache[1]
-        # reuse_buffers on non-CPU backends only: the TPU device_put is a
-        # real copy through the tunnel, so the reused host buffer is free
-        # once block_until_ready returns (fresh allocations measured ~4x
-        # slower).  On CPU, device_put can be ZERO-COPY — a cached cohort
-        # block could alias the reuse buffer and be silently overwritten
-        # by the next cohort's pack (the ADVICE r1 hazard).
-        pack = pack_clients(
-            self.dataset,
-            ids,
-            self.cfg.batch_size,
-            steps_per_epoch=self.steps_per_epoch,
-            seed=self.cfg.seed,
-            reuse_buffers=jax.default_backend() != "cpu",
+        args, _ = device_resident_pack(
+            self.dataset, ids, self.cfg.batch_size,
+            steps_per_epoch=self.steps_per_epoch, seed=self.cfg.seed,
         )
-        args = tuple(
-            jax.device_put(jnp.asarray(a))
-            for a in (pack.x, pack.y, pack.mask, pack.num_samples)
-        )
-        # ALL transfers must land before the reused host buffers (x AND
-        # y) may be overwritten by the next pack_clients call
-        jax.block_until_ready(args)
         self._pack_cache = (key, args)
         return args
 

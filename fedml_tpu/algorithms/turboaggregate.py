@@ -141,24 +141,38 @@ class TurboAggregateSimulation:
         self._test_pack = batch_eval_pack(dataset.test_x, dataset.test_y, 64)
         self.round_idx = 0
         self.history: List[dict] = []
+        self._pack_cache = None
+
+    def _device_pack(self):
+        """Device-resident full-cohort block, packed once (round
+        stochasticity is the on-device per-epoch permutation keyed per
+        round — see FedAvgSimulation._device_pack)."""
+        if self._pack_cache is None:
+            from fedml_tpu.core.types import device_resident_pack
+
+            args, host_ns = device_resident_pack(
+                self.dataset, np.arange(self.cfg.num_clients),
+                self.cfg.batch_size,
+                steps_per_epoch=self.steps_per_epoch, seed=self.cfg.seed,
+            )
+            # host-side num_samples: the secure-aggregation weights are
+            # computed on host every round — no device readback
+            self._pack_cache = (args[:3], host_ns)
+        return self._pack_cache
 
     def run_round(self) -> dict:
         cfg = self.cfg
         ids = np.arange(cfg.num_clients)
-        pack = pack_clients(
-            self.dataset, ids, cfg.batch_size,
-            steps_per_epoch=self.steps_per_epoch, seed=cfg.seed + self.round_idx,
-        )
+        (px, py, pm), host_ns = self._device_pack()
         k_round = jax.random.fold_in(jax.random.fold_in(self.key, self.round_idx), 0)
         rngs = jax.vmap(lambda i: jax.random.fold_in(k_round, i))(
             jnp.asarray(ids, jnp.int32)
         )
         client_vars, metrics = self._local(
-            self.variables, jnp.asarray(pack.x), jnp.asarray(pack.y),
-            jnp.asarray(pack.mask), rngs,
+            self.variables, px, py, pm, rngs,
         )
         # secure aggregation of the weighted client models (host protocol)
-        weights = np.asarray(pack.num_samples, np.float64)
+        weights = np.asarray(host_ns, np.float64)
         weights = weights / weights.sum()
         vecs = [
             np.asarray(treelib.tree_ravel(treelib.tree_index(client_vars, i)))

@@ -291,6 +291,45 @@ def pack_clients(
     )
 
 
+def device_resident_pack(
+    dataset: FedDataset,
+    ids,
+    batch_size: int,
+    *,
+    steps_per_epoch: int,
+    seed: int,
+) -> Tuple[Tuple, np.ndarray]:
+    """Pack a cohort ONCE and put it on device for the whole run — the
+    shared primitive behind every driver's resident-cohort cache
+    (``FedAvgSimulation._device_pack`` documents the rationale and the
+    measured per-round transfer cost it removes).
+
+    Returns ``((x, y, mask, num_samples) device arrays, host
+    num_samples)`` — callers that weight aggregation on host keep the
+    numpy copy instead of reading the device array back every round.
+
+    ``reuse_buffers`` only off-CPU: the TPU device_put is a real copy,
+    so the reused host buffer is free once block_until_ready returns
+    (ALL transfers — x AND y share the reuse cache); on CPU device_put
+    can be ZERO-COPY and a cached block could alias the reuse buffer
+    and be silently overwritten by the next cohort's pack.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    pack = pack_clients(
+        dataset, ids, batch_size, steps_per_epoch=steps_per_epoch,
+        seed=seed, reuse_buffers=jax.default_backend() != "cpu",
+    )
+    host_ns = np.asarray(pack.num_samples).copy()
+    args = tuple(
+        jax.device_put(jnp.asarray(a))
+        for a in (pack.x, pack.y, pack.mask, pack.num_samples)
+    )
+    jax.block_until_ready(args)
+    return args, host_ns
+
+
 def cohort_steps_per_epoch(dataset: FedDataset, batch_size: int) -> int:
     """Pack geometry shared by every cohort driver: steps to cover the
     LARGEST client at ``batch_size`` (smaller clients pad-by-wrapping).

@@ -256,8 +256,14 @@ def eval_summary(res) -> dict:
     driver reports (shared so the simulation and DP×TP paths can't
     drift apart)."""
     count = float(res["count"])
-    return {
+    out = {
         "test_acc": float(res["correct"]) / max(count, 1.0),
         "test_loss": float(res["loss_sum"]) / max(count, 1.0),
         "test_count": count,
     }
+    # multi-label tasks (losses.masked_multilabel_bce) also report the
+    # reference's precision/recall (my_model_trainer_tag_prediction.py:88-93)
+    if "precision_sum" in res:
+        out["test_precision"] = float(res["precision_sum"]) / max(count, 1.0)
+        out["test_recall"] = float(res["recall_sum"]) / max(count, 1.0)
+    return out

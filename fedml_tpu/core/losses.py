@@ -48,16 +48,55 @@ def masked_softmax_ce(logits: jax.Array, y: jax.Array, mask: jax.Array):
     return loss, {"loss_sum": (nll * mask).sum(), "correct": correct, "count": mask.sum()}
 
 
+def _bce_elements(logits: jax.Array, yf: jax.Array) -> jax.Array:
+    """Numerically stable per-element BCE-with-logits."""
+    return (
+        jnp.maximum(logits, 0) - logits * yf
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    )
+
+
 def masked_bce_logits(logits: jax.Array, y: jax.Array, mask: jax.Array):
     """Binary cross-entropy on logits (VFL / lending-club binary tasks)."""
     logits = logits.astype(jnp.float32).reshape(y.shape)
     yf = y.astype(jnp.float32)
-    per = jnp.maximum(logits, 0) - logits * yf + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    per = _bce_elements(logits, yf)
     denom = jnp.maximum(mask.sum(), 1.0)
     loss = (per * mask).sum() / denom
     pred = (logits > 0).astype(yf.dtype)
     correct = ((pred == yf) * mask).sum()
     return loss, {"loss_sum": (per * mask).sum(), "correct": correct, "count": mask.sum()}
+
+
+def masked_multilabel_bce(logits: jax.Array, y: jax.Array, mask: jax.Array):
+    """Multi-label tag prediction: per-sample BCE summed over the label
+    axis, plus the reference's exact-match / precision / recall metrics
+    (``standalone/fedavg/my_model_trainer_tag_prediction.py:24,54-96``:
+    ``nn.BCELoss(reduction='sum')`` on sigmoid outputs ≡ BCE-with-logits
+    here; ``predicted = (pred > .5)``; "correct" counts samples whose
+    ENTIRE tag vector matches).
+
+    Shapes: logits [B, C] (or [..., C]), y multi-hot [..., C] float,
+    mask [...] per-sample.  Loss = masked mean over samples of the
+    per-sample label-summed BCE.
+    """
+    logits = logits.astype(jnp.float32).reshape(y.shape)
+    yf = y.astype(jnp.float32)
+    per = _bce_elements(logits, yf).sum(axis=-1)  # BCELoss(sum) per sample
+    denom = jnp.maximum(mask.sum(), 1.0)
+    loss = (per * mask).sum() / denom
+    pred = (logits > 0.0).astype(jnp.float32)  # sigmoid(z) > .5  ⇔  z > 0
+    exact = jnp.all(pred == yf, axis=-1).astype(jnp.float32)
+    tp = (yf * pred).sum(axis=-1)
+    precision = tp / (pred.sum(axis=-1) + 1e-13)
+    recall = tp / (yf.sum(axis=-1) + 1e-13)
+    return loss, {
+        "loss_sum": (per * mask).sum(),
+        "correct": (exact * mask).sum(),
+        "count": mask.sum(),
+        "precision_sum": (precision * mask).sum(),
+        "recall_sum": (recall * mask).sum(),
+    }
 
 
 def masked_kd_kl(

@@ -86,6 +86,56 @@ def load_data(
     raise ValueError(f"unknown dataset: {dataset}")
 
 
+def task_loss_for_dataset(dataset: str):
+    """Per-dataset task loss — the reference selects a trainer class by
+    dataset (``standalone/fedavg/fedavg_api.py`` passes
+    ``my_model_trainer_tag_prediction`` for stackoverflow_lr, the
+    NWP/classification trainers otherwise).  Here the same switch picks
+    the masked loss every driver threads through its round kernel."""
+    from fedml_tpu.core import losses
+
+    if dataset == "stackoverflow_lr":  # multi-label tag prediction
+        return losses.masked_multilabel_bce
+    return losses.masked_softmax_ce
+
+
+def shrink_dataset(
+    ds: FedDataset,
+    max_samples_per_client: int = 0,
+    max_test_samples: int = 0,
+) -> FedDataset:
+    """Deterministically cap per-client shard sizes and the test set.
+
+    The smoke tier shrinks the REAL task instead of substituting a
+    synthetic one (the reference's ``--ci 1`` swaps the dataset out,
+    ``FedAVGAggregator.py:115-120`` — which is how broken task wiring
+    survives CI; here the model/dataset/loss pair under test is always
+    the real one, just smaller).
+    """
+    import dataclasses as _dc
+
+    if not (max_samples_per_client or max_test_samples):
+        return ds
+    train_idx = ds.train_client_idx
+    if max_samples_per_client:
+        train_idx = {
+            c: idx[:max_samples_per_client] for c, idx in train_idx.items()
+        }
+    test_x, test_y = ds.test_x, ds.test_y
+    test_idx = ds.test_client_idx
+    if max_test_samples and len(test_y) > max_test_samples:
+        test_x = test_x[:max_test_samples]
+        test_y = test_y[:max_test_samples]
+        if test_idx is not None:
+            test_idx = {
+                c: idx[idx < max_test_samples] for c, idx in test_idx.items()
+            }
+    return _dc.replace(
+        ds, train_client_idx=train_idx, test_x=test_x, test_y=test_y,
+        test_client_idx=test_idx,
+    )
+
+
 def create_model(
     model: str, dataset: str, num_classes: int,
     image_size: Optional[int] = None,

@@ -23,7 +23,9 @@ import sys
 import time
 
 from fedml_tpu.core.config import config_to_json, parse_config
-from fedml_tpu.experiments.registry import create_model, load_data
+from fedml_tpu.experiments.registry import (create_model, load_data,
+                                            shrink_dataset,
+                                            task_loss_for_dataset)
 
 ALGORITHMS = (
     "fedavg", "fedopt", "fedprox", "fednova", "fedavg_robust",
@@ -84,29 +86,40 @@ class ExperimentConfig:
     # beyond-reference knobs available on the FedAvg-engine family
     compute_dtype: str = ""  # "bf16" = mixed-precision local training
     drop_prob: float = 0.0  # failure injection: P(client dies mid-round)
+    # smoke-tier shrink knobs (0 = unlimited): cap each client's shard /
+    # the test set AFTER the real loader runs — the task is never swapped
+    max_samples_per_client: int = 0
+    max_test_samples: int = 0
 
 
 def _apply_ci(cfg: ExperimentConfig) -> ExperimentConfig:
+    """``--ci 1`` = shrink-only smoke preset.
+
+    The reference's CI substitutes the task itself (its CI scripts run a
+    fixed tiny config regardless of flags), which lets broken (model,
+    dataset) wiring survive — the round-2 stackoverflow_lr crash lived
+    in exactly that blind spot.  Here CI clamps sizes via the public
+    shrink knobs and NEVER changes algorithm/model/dataset/loss.
+    """
     if cfg.ci:
-        if cfg.algorithm == "fedllm":  # needs a token dataset, not features
-            token_sets = ("fed_shakespeare", "shakespeare", "stackoverflow_nwp")
+        if cfg.algorithm == "fedllm":  # token-sequence family: keep task,
+            # shrink the transformer too
             return dataclasses.replace(
                 cfg,
-                dataset=cfg.dataset if cfg.dataset in token_sets
-                else "fed_shakespeare",
                 client_num_in_total=min(cfg.client_num_in_total, 4),
                 client_num_per_round=min(cfg.client_num_per_round, 4),
                 comm_round=min(cfg.comm_round, 2),
                 batch_size=min(cfg.batch_size, 4),
                 embed_dim=min(cfg.embed_dim, 32), num_layers=1,
+                max_samples_per_client=cfg.max_samples_per_client or 16,
+                max_test_samples=cfg.max_test_samples or 32,
             )
         return dataclasses.replace(
             cfg, client_num_in_total=min(cfg.client_num_in_total, 3),
             client_num_per_round=min(cfg.client_num_per_round, 3),
             comm_round=min(cfg.comm_round, 2), batch_size=min(cfg.batch_size, 8),
-            dataset="synthetic" if cfg.dataset not in ("mnist", "synthetic")
-            else cfg.dataset,
-            model="lr" if cfg.model not in ("lr", "cnn") else cfg.model,
+            max_samples_per_client=cfg.max_samples_per_client or 16,
+            max_test_samples=cfg.max_test_samples or 64,
         )
     return cfg
 
@@ -302,8 +315,12 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         )
         return {"history": hist, "wall_s": time.time() - t0}
 
-    ds = load_data(cfg.dataset, cfg.data_dir, cfg.client_num_in_total,
-                   cfg.partition_method, cfg.partition_alpha, cfg.seed)
+    ds = shrink_dataset(
+        load_data(cfg.dataset, cfg.data_dir, cfg.client_num_in_total,
+                  cfg.partition_method, cfg.partition_alpha, cfg.seed),
+        cfg.max_samples_per_client, cfg.max_test_samples,
+    )
+    loss_fn = task_loss_for_dataset(cfg.dataset)
 
     if cfg.algorithm == "splitnn":
         from fedml_tpu.algorithms.splitnn import SplitNNSimulation
@@ -388,7 +405,7 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         trainer = CentralizedTrainer(
             bundle, ds, batch_size=cfg.batch_size, lr=cfg.lr,
             optimizer=cfg.client_optimizer, weight_decay=cfg.wd,
-            momentum=cfg.momentum, seed=cfg.seed,
+            momentum=cfg.momentum, seed=cfg.seed, loss_fn=loss_fn,
         )
         hist = [trainer.train(epochs=cfg.epochs)
                 for _ in range(cfg.comm_round)]
@@ -407,6 +424,7 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         sim = DecentralizedSimulation(
             bundle, ds, tm.generate_topology(), epochs=cfg.epochs,
             batch_size=cfg.batch_size, lr=cfg.lr, seed=cfg.seed,
+            loss_fn=loss_fn,
         )
         hist = sim.run(cfg.comm_round)
         final = sim.evaluate_worker(0)
@@ -420,7 +438,7 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
             num_clients=ds.num_clients, comm_rounds=cfg.comm_round,
             epochs=cfg.epochs, batch_size=cfg.batch_size, lr=cfg.lr,
             seed=cfg.seed,
-        ))
+        ), loss_fn=loss_fn)
         hist = algo.run()
         return {"history": hist, "wall_s": time.time() - t0}
 
@@ -438,31 +456,33 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         drop_prob=cfg.drop_prob,
     )
     if cfg.algorithm == "fedavg":
-        sim = fa.FedAvgSimulation(bundle, ds, fa.FedAvgConfig(**common))
+        sim = fa.FedAvgSimulation(bundle, ds, fa.FedAvgConfig(**common),
+                                  loss_fn=loss_fn)
     elif cfg.algorithm == "fedprox":
         from fedml_tpu.algorithms.fedprox import FedProxSimulation
 
         sim = FedProxSimulation(bundle, ds, fa.FedAvgConfig(**common),
-                                mu=cfg.mu)
+                                mu=cfg.mu, loss_fn=loss_fn)
     elif cfg.algorithm == "fedopt":
         from fedml_tpu.algorithms.fedopt import FedOptSimulation
 
         sim = FedOptSimulation(
             bundle, ds, fa.FedAvgConfig(**common),
             server_optimizer=cfg.server_optimizer, server_lr=cfg.server_lr,
+            loss_fn=loss_fn,
         )
     elif cfg.algorithm == "fednova":
         nova_cfg = fa.FedAvgConfig(**{**common, "weight_decay": 0.0})
         from fedml_tpu.algorithms.fednova import FedNovaSimulation
 
-        sim = FedNovaSimulation(bundle, ds, nova_cfg)
+        sim = FedNovaSimulation(bundle, ds, nova_cfg, loss_fn=loss_fn)
     elif cfg.algorithm == "fedavg_robust":
         from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustSimulation
 
         sim = FedAvgRobustSimulation(
             bundle, ds, fa.FedAvgConfig(**common),
             defense_type=cfg.defense_type, norm_bound=cfg.norm_bound,
-            stddev=cfg.stddev,
+            stddev=cfg.stddev, loss_fn=loss_fn,
         )
     elif cfg.algorithm == "hierarchical":
         from fedml_tpu.algorithms.hierarchical import HierarchicalSimulation
@@ -470,6 +490,7 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         sim = HierarchicalSimulation(
             bundle, ds, fa.FedAvgConfig(**common),
             num_groups=cfg.group_num, group_comm_round=cfg.group_comm_round,
+            loss_fn=loss_fn,
         )
     else:
         raise ValueError(f"unknown algorithm: {cfg.algorithm}")

@@ -69,6 +69,124 @@ def test_run_experiment_hierarchical_and_vfl():
     assert "auc" in out2["history"][-1] or "acc" in out2["history"][-1]
 
 
+# ---------------------------------------------------------------------------
+# The REAL benchmark matrix (reference benchmark/README.md tables): every
+# (model, dataset) pair the reference publishes numbers for runs through
+# run_experiment with the real loader + model + task loss — no ci task
+# substitution (the r2 stackoverflow_lr crash survived two rounds behind
+# the reference-style synthetic swap).  Sizes are cut via the shrink
+# knobs only; dataset stand-ins keep every loader's real output contract.
+# Conv-family pairs are compile-heavy on the 1-core CPU box and live in
+# the slow tier; wiring-distinct light pairs gate every change.
+# ---------------------------------------------------------------------------
+
+BENCHMARK_PAIRS_LIGHT = [
+    ("lr", "mnist"),             # Linear Models row 1
+    ("lr", "femnist"),           # Linear Models row 2
+    ("lr", "synthetic"),         # Linear Models row 3, Synthetic(α,β)
+    ("lr", "stackoverflow_lr"),  # multi-label tag prediction (r2 crash)
+    ("cnn", "femnist"),          # shallow-NN row 1
+    ("rnn", "fed_shakespeare"),  # shallow-NN row 3 (seq output)
+    ("rnn", "stackoverflow_nwp"),  # shallow-NN row 4
+]
+
+BENCHMARK_PAIRS_HEAVY = [
+    ("rnn", "shakespeare"),          # LEAF variant (non-seq output)
+    ("resnet18_gn", "fed_cifar100"),  # shallow-NN row 2
+    ("resnet56", "cifar10"),         # cross-silo DNN rows
+    ("resnet56", "cifar100"),
+    ("resnet56", "cinic10"),
+    ("mobilenet", "cifar10"),
+    ("mobilenet", "cifar100"),
+    ("mobilenet", "cinic10"),
+]
+
+
+def _matrix_cfg(model, dataset):
+    return ExperimentConfig(
+        algorithm="fedavg", model=model, dataset=dataset,
+        client_num_in_total=3, client_num_per_round=2, comm_round=1,
+        batch_size=4, epochs=1, lr=0.05, frequency_of_the_test=1,
+        max_samples_per_client=8, max_test_samples=16, ci=0,
+    )
+
+
+@pytest.mark.parametrize("model,dataset", BENCHMARK_PAIRS_LIGHT)
+def test_benchmark_matrix(model, dataset):
+    out = run_experiment(_matrix_cfg(model, dataset), log_fn=None)
+    final = out["final"]
+    assert np.isfinite(final["test_acc"]) and np.isfinite(final["test_loss"])
+    if dataset == "stackoverflow_lr":
+        # reference tag-prediction metrics (my_model_trainer_tag_prediction.py)
+        assert np.isfinite(final["test_precision"])
+        assert np.isfinite(final["test_recall"])
+
+
+@pytest.mark.slow  # conv compiles ~25-40s each on the 1-core CPU box
+@pytest.mark.parametrize("model,dataset", BENCHMARK_PAIRS_HEAVY)
+def test_benchmark_matrix_conv(model, dataset):
+    out = run_experiment(_matrix_cfg(model, dataset), log_fn=None)
+    final = out["final"]
+    assert np.isfinite(final["test_acc"]) and np.isfinite(final["test_loss"])
+
+
+def test_ci_never_swaps_the_task():
+    """--ci 1 must shrink sizes, not substitute model/dataset (r2 Weak #1)."""
+    from fedml_tpu.experiments.run import _apply_ci
+
+    cfg = _apply_ci(ExperimentConfig(
+        algorithm="fedavg", model="resnet56", dataset="cifar10", ci=1))
+    assert cfg.model == "resnet56" and cfg.dataset == "cifar10"
+    assert cfg.max_samples_per_client > 0 and cfg.max_test_samples > 0
+    assert cfg.comm_round <= 2 and cfg.batch_size <= 8
+    llm = _apply_ci(ExperimentConfig(
+        algorithm="fedllm", dataset="stackoverflow_nwp", ci=1))
+    assert llm.dataset == "stackoverflow_nwp"
+
+
+def test_shrink_dataset_caps_shards():
+    from fedml_tpu.experiments.registry import shrink_dataset
+
+    ds = load_data("synthetic", num_clients=4)
+    small = shrink_dataset(ds, max_samples_per_client=5, max_test_samples=7)
+    assert all(len(v) <= 5 for v in small.train_client_idx.values())
+    assert len(small.test_y) == 7
+    assert small.num_classes == ds.num_classes
+    # no-op path returns the dataset unchanged
+    assert shrink_dataset(ds) is ds
+
+
+def test_multilabel_bce_matches_reference_semantics():
+    """masked_multilabel_bce vs torch BCELoss(sum) + the reference's
+    exact-match/precision/recall math on random multi-hot labels."""
+    import torch
+
+    from fedml_tpu.core.losses import masked_multilabel_bce
+
+    rng = np.random.RandomState(0)
+    logits = rng.randn(6, 11).astype(np.float32)
+    y = (rng.rand(6, 11) < 0.25).astype(np.float32)
+    mask = np.array([1, 1, 1, 1, 1, 0], np.float32)
+
+    loss, aux = masked_multilabel_bce(logits, y, mask)
+    tl = torch.tensor(logits[:5])
+    ty = torch.tensor(y[:5])
+    ref_loss = torch.nn.BCELoss(reduction="sum")(torch.sigmoid(tl), ty)
+    np.testing.assert_allclose(float(aux["loss_sum"]), float(ref_loss), rtol=1e-5)
+    np.testing.assert_allclose(float(loss), float(ref_loss) / 5.0, rtol=1e-5)
+
+    pred = (torch.sigmoid(tl) > 0.5).int()
+    correct = pred.eq(ty).sum(axis=-1).eq(ty.size(1)).sum()
+    tp = ((ty * pred) > 0.1).int().sum(axis=-1)
+    precision = tp / (pred.sum(axis=-1) + 1e-13)
+    recall = tp / (ty.sum(axis=-1) + 1e-13)
+    assert float(aux["correct"]) == float(correct)
+    np.testing.assert_allclose(float(aux["precision_sum"]),
+                               float(precision.sum()), rtol=1e-5)
+    np.testing.assert_allclose(float(aux["recall_sum"]),
+                               float(recall.sum()), rtol=1e-5)
+
+
 def test_run_experiment_fedllm_and_dp_tp():
     from fedml_tpu.experiments.run import ExperimentConfig, run_experiment
 

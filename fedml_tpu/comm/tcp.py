@@ -180,13 +180,24 @@ class TcpBackend(CommBackend):
         try:
             while (remaining := deadline - _time.monotonic()) > 0:
                 self._sock.settimeout(max(remaining, 0.05))
-                self._sock.sendall(
-                    (json.dumps({"__hub__": "peers"}) + "\n").encode()
-                )
                 try:
+                    self._sock.sendall(
+                        (json.dumps({"__hub__": "peers"}) + "\n").encode()
+                    )
                     line = self._file.readline()
                 except TimeoutError:
-                    break  # budget exhausted mid-read
+                    # A timed-out readline (or partial sendall) leaves
+                    # the stream mid-frame: the buffered reader discards
+                    # the partial bytes, so any later read would parse
+                    # the frame's TAIL as a fresh line (ADVICE r2).  The
+                    # connection can no longer be trusted frame-aligned —
+                    # kill it so reuse fails loudly instead of corrupting.
+                    self._kill_connection()
+                    raise TimeoutError(
+                        f"node {self.node_id}: hub read timed out mid-"
+                        "frame during await_peers; connection closed "
+                        "(a resumed read could split a frame)"
+                    ) from None
                 except OSError as e:
                     # a reset/closed socket is a dead hub, not slow peers
                     raise ConnectionError(
@@ -203,11 +214,33 @@ class TcpBackend(CommBackend):
                         return
                     _time.sleep(0.05)
         finally:
-            self._sock.settimeout(None)
+            try:
+                self._sock.settimeout(None)
+            except OSError:
+                pass  # _kill_connection already closed it
+        # budget spent between reads: every readline returned a FULL line,
+        # so the stream is still frame-aligned and the backend is reusable
         raise TimeoutError(
             f"node {self.node_id}: peers {sorted(want)} not all registered "
             f"within {timeout}s"
         )
+
+    def _kill_connection(self) -> None:
+        """Mark the backend unusable and close the socket (desync-fatal
+        paths): later send_message/run calls get OSError immediately."""
+        self._stopped.set()
+        # shutdown() disables the connection immediately even though the
+        # makefile() reader still holds a reference to the fd (a bare
+        # close() is deferred by that refcount and sends would still work)
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        for closer in (self._file.close, self._sock.close):
+            try:
+                closer()
+            except OSError:
+                pass
 
     def run(self) -> None:
         while not self._stopped.is_set():

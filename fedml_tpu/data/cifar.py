@@ -73,7 +73,7 @@ def _load_generic(data_dir: str, name: str):
 def _build(
     arrays: Optional[Tuple], mean, std, num_classes: int, name: str,
     num_clients: int, partition: str, partition_alpha: float, seed: int,
-    synthetic_size: Tuple[int, int],
+    synthetic_size: Tuple[int, int], normalized: bool = False,
 ) -> FedDataset:
     if arrays is None:
         return synthetic_classification(
@@ -84,8 +84,9 @@ def _build(
             name=f"{name}(synthetic-standin)",
         )
     train_x, train_y, test_x, test_y = arrays
-    train_x = _normalize(train_x, mean, std)
-    test_x = _normalize(test_x, mean, std)
+    if not normalized:
+        train_x = _normalize(train_x, mean, std)
+        test_x = _normalize(test_x, mean, std)
     client_idx = partition_data(
         train_y, num_clients, partition, partition_alpha, seed
     )
@@ -132,9 +133,29 @@ def load_cinic10(
     data_dir: str = "./data/cinic10", num_clients: int = 10,
     partition: str = "hetero", partition_alpha: float = 0.5, seed: int = 0,
 ) -> FedDataset:
-    """CINIC-10 ships as an ImageFolder tree; the npz layout (or the
-    synthetic stand-in) is used here — folder decoding without PIL/cv2
-    is deliberately out of scope for the offline environment."""
+    """CINIC-10 ships as an ImageFolder tree (``train/<class>/*.png`` +
+    ``test/<class>/*.png``, reference ``cinic10/data_loader.py:218-226``)
+    — parsed with PIL here, normalized in the same decode pass with the
+    CINIC constants.  Fallbacks: the npz layout, then the synthetic
+    stand-in."""
+    if os.path.isdir(os.path.join(data_dir, "train")):
+        from fedml_tpu.data.imagefolder import decode_images, scan_class_tree
+
+        tr_paths, tr_y, classes = scan_class_tree(
+            os.path.join(data_dir, "train")
+        )
+        te_dir = os.path.join(data_dir, "test")
+        te_paths, te_y, _ = (
+            scan_class_tree(te_dir) if os.path.isdir(te_dir)
+            else (tr_paths[:64], tr_y[:64], classes)
+        )
+        arrays = (
+            decode_images(tr_paths, 32, CINIC10_MEAN, CINIC10_STD), tr_y,
+            decode_images(te_paths, 32, CINIC10_MEAN, CINIC10_STD), te_y,
+        )
+        return _build(arrays, CINIC10_MEAN, CINIC10_STD, 10, "cinic10",
+                      num_clients, partition, partition_alpha, seed,
+                      (5000, 1000), normalized=True)
     arrays = _load_generic(data_dir, "cinic10")
     return _build(arrays, CINIC10_MEAN, CINIC10_STD, 10, "cinic10",
                   num_clients, partition, partition_alpha, seed,

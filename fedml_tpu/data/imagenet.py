@@ -2,13 +2,15 @@
 federated loaders.
 
 Reference: ``fedml_api/data_preprocessing/ImageNet/data_loader.py``
-(folder tree, 1000 classes, uniform client split) and ``Landmarks/``
-(CSV mapping ``user_id → image file``: natural per-photographer
-partition, 233 clients for gld23k).  Raw JPEG decoding needs PIL which
-this offline build treats as optional: when a preprocessed ``.npz``
-(``x_train/y_train/x_test/y_test`` [+ ``user_train`` client ids]) is
-present it is used, otherwise a synthetic stand-in with matching
-geometry is returned.
+(JPEG folder tree ``train/<class>/``+``val/<class>/``, 1000 classes,
+clients = contiguous class blocks) and ``Landmarks/data_loader.py``
+(CSV mapping ``user_id,image_id,class`` → ``<image_id>.jpg`` files:
+natural per-photographer partition, 233 clients for gld23k).  Both
+real on-disk formats are parsed here with PIL (``data/imagefolder.py``;
+fixture-tested with generated JPEGs in ``tests/test_data_fixtures.py``).
+Fallbacks, in order: a preprocessed ``.npz`` (``x_train/y_train/
+x_test/y_test`` [+ ``user_train`` client ids]), then a synthetic
+stand-in with matching geometry (zero-egress environments).
 """
 
 from __future__ import annotations
@@ -21,6 +23,13 @@ import numpy as np
 from fedml_tpu.core.partition import partition_data
 from fedml_tpu.core.types import FedDataset
 from fedml_tpu.data.synthetic import synthetic_classification
+
+# reference ImageNet/data_loader.py:41-43
+IMAGENET_MEAN = (0.485, 0.456, 0.406)
+IMAGENET_STD = (0.229, 0.224, 0.225)
+# reference Landmarks/data_loader.py:98-100
+LANDMARKS_MEAN = (0.5, 0.5, 0.5)
+LANDMARKS_STD = (0.5, 0.5, 0.5)
 
 
 def _from_npz(path: str, num_classes: int, num_clients: int, name: str,
@@ -45,12 +54,56 @@ def _from_npz(path: str, num_classes: int, num_clients: int, name: str,
     )
 
 
+def _from_folder_tree(
+    data_dir: str, num_clients: int, image_size: int, name: str,
+    mean, std, test_subdir: str = "val", max_per_class: int = 0,
+) -> FedDataset:
+    """The reference's ImageNet on-disk format: ``train/<class>/*.jpg``
+    + ``val/<class>/*.jpg`` (``ImageNet/datasets.py:92-97``), clients =
+    contiguous class blocks (``data_loader.py:154-162``).
+
+    Memory model: decoded images land in ONE host float32 array (the
+    cohort packers ship arrays to HBM), so this path fits subsets /
+    downsized trees — full ILSVRC2012 at 224² is ~770 GB and must be
+    capped (``max_per_class``), decoded at a smaller ``image_size``, or
+    preprocessed into the sharded npz route."""
+    from fedml_tpu.data.imagefolder import (contiguous_class_clients,
+                                            decode_images, scan_class_tree)
+
+    train_paths, train_y, classes = scan_class_tree(
+        os.path.join(data_dir, "train"), max_per_class=max_per_class
+    )
+    test_root = os.path.join(data_dir, test_subdir)
+    if os.path.isdir(test_root):
+        test_paths, test_y, _ = scan_class_tree(
+            test_root, max_per_class=max_per_class
+        )
+    else:
+        test_paths, test_y = train_paths[:64], train_y[:64]
+    train_x = decode_images(train_paths, image_size, mean, std)
+    test_x = decode_images(test_paths, image_size, mean, std)
+    num_classes = len(classes)
+    return FedDataset(
+        train_x=train_x, train_y=train_y, test_x=test_x, test_y=test_y,
+        train_client_idx=contiguous_class_clients(
+            train_y, num_classes, min(num_clients, num_classes)
+        ),
+        test_client_idx=None, num_classes=num_classes, name=name,
+    )
+
+
 def load_imagenet(
     data_dir: str = "./data/ImageNet",
     num_clients: int = 100,
     image_size: int = 224,
     seed: int = 0,
+    max_per_class: int = 0,
 ) -> FedDataset:
+    if os.path.isdir(os.path.join(data_dir, "train")):
+        return _from_folder_tree(
+            data_dir, num_clients, image_size, "imagenet",
+            IMAGENET_MEAN, IMAGENET_STD, max_per_class=max_per_class,
+        )
     path = os.path.join(data_dir, "imagenet_federated.npz")
     if os.path.exists(path):
         return _from_npz(path, 1000, num_clients, "imagenet", seed)
@@ -62,13 +115,60 @@ def load_imagenet(
     )
 
 
+def _from_user_map_csv(
+    data_dir: str, train_map: str, test_map: str, image_size: int,
+    num_classes: int, name: str,
+) -> FedDataset:
+    """The reference's Landmarks on-disk format: CSV rows
+    ``user_id,image_id,class`` mapped to ``<data_dir>/<image_id>.jpg``
+    (``Landmarks/data_loader.py:125-161``, ``datasets.py:46-49``)."""
+    from fedml_tpu.data.imagefolder import (decode_images,
+                                            group_rows_per_user,
+                                            read_user_map_csv)
+
+    rows, client_idx = group_rows_per_user(read_user_map_csv(train_map))
+    test_rows = read_user_map_csv(test_map) if os.path.exists(test_map) \
+        else rows[:64]
+
+    def arrays(rs):
+        paths = [os.path.join(data_dir, f"{r['image_id']}.jpg") for r in rs]
+        y = np.asarray([int(r["class"]) for r in rs], np.int32)
+        return decode_images(
+            paths, image_size, LANDMARKS_MEAN, LANDMARKS_STD
+        ), y
+
+    train_x, train_y = arrays(rows)
+    test_x, test_y = arrays(test_rows)
+    classes = int(max(train_y.max(initial=0), test_y.max(initial=0))) + 1
+    return FedDataset(
+        train_x=train_x, train_y=train_y, test_x=test_x, test_y=test_y,
+        train_client_idx=client_idx, test_client_idx=None,
+        num_classes=max(num_classes, classes), name=name,
+    )
+
+
 def load_landmarks(
     data_dir: str = "./data/gld",
     variant: str = "gld23k",   # 233 clients / 203 classes (reference)
     image_size: int = 224,
     seed: int = 0,
+    train_map: Optional[str] = None,
+    test_map: Optional[str] = None,
 ) -> FedDataset:
     num_clients, num_classes = (233, 203) if variant == "gld23k" else (1262, 2028)
+    # reference map-file names (main_fedavg.py:170-171 gld23k,
+    # :185-186 gld160k); images live under <data_dir>/images
+    trn, tst = (
+        ("mini_gld_train_split.csv", "mini_gld_test.csv")
+        if variant == "gld23k" else ("federated_train.csv", "test.csv")
+    )
+    train_map = train_map or os.path.join(data_dir, trn)
+    test_map = test_map or os.path.join(data_dir, tst)
+    if os.path.exists(train_map):
+        return _from_user_map_csv(
+            os.path.join(data_dir, "images"), train_map, test_map,
+            image_size, num_classes, variant,
+        )
     path = os.path.join(data_dir, f"{variant}_federated.npz")
     if os.path.exists(path):
         return _from_npz(path, num_classes, num_clients, variant, seed)

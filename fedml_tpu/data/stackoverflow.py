@@ -96,8 +96,10 @@ def load_stackoverflow_lr(
     seed: int = 0,
 ) -> FedDataset:
     """Multi-label tag prediction: x = normalized bag-of-words
-    [N, num_features], y = multi-hot tags [N, num_tags] (use
-    ``losses.masked_bce_logits``)."""
+    [N, num_features], y = multi-hot tags [N, num_tags].  Task loss:
+    ``losses.masked_multilabel_bce`` (exact-match/precision/recall
+    metrics) — ``registry.task_loss_for_dataset`` wires it for every
+    driver."""
     tr = os.path.join(data_dir, "stackoverflow_lr_train.h5")
     if os.path.exists(tr):
         import h5py

@@ -303,6 +303,12 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         from fedml_tpu.models.finance import vfl_party
 
         x, y, splits = load_lending_club(cfg.data_dir or "./data/lending_club_loan")
+        if cfg.max_samples_per_client:
+            # the shrink contract holds for vfl too: parties share rows,
+            # so cap the TABLE (train rows + test rows), not per-party
+            cap = (cfg.max_samples_per_client * cfg.client_num_in_total
+                   + (cfg.max_test_samples or 64))
+            x, y = x[:cap], y[:cap]
         n_test = max(32, len(y) // 5)
         xs = [x[:, s] for s in splits]
         fed = VerticalFederation(

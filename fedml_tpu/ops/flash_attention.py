@@ -88,8 +88,11 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
             o_ref.dtype
         )
         # log-sum-exp per query row — the softmax statistic the custom
-        # backward needs to recompute p without re-running the online max
-        lse_ref[:] = m_scr[:] + jnp.log(jnp.maximum(l_scr[:], 1e-30))
+        # backward needs to recompute p without re-running the online max.
+        # Single-lane output: the m/l scratch is lane-replicated, but
+        # writing all 128 lanes to HBM costs 512B/row of pure waste
+        # (ADVICE r2) — Mosaic takes a (block_q, 1) block fine.
+        lse_ref[:] = m_scr[:, :1] + jnp.log(jnp.maximum(l_scr[:, :1], 1e-30))
 
 
 def _flash_single(q, k, v, *, causal, block_q, block_k, interpret):
@@ -130,12 +133,11 @@ def _flash_single(q, k, v, *, causal, block_q, block_k, interpret):
         ],
         out_specs=[
             pl.BlockSpec((block_q, D), lambda qi, ki: (qi, 0)),
-            # lse rows replicated across the 128 lanes of the m/l scratch
-            pl.BlockSpec((block_q, 128), lambda qi, ki: (qi, 0)),
+            pl.BlockSpec((block_q, 1), lambda qi, ki: (qi, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((Lq, D), q.dtype),
-            jax.ShapeDtypeStruct((Lq, 128), jnp.float32),
+            jax.ShapeDtypeStruct((Lq, 1), jnp.float32),
         ],
         scratch_shapes=scratch_shapes,
         interpret=interpret,

@@ -154,3 +154,41 @@ def test_inproc_bus_unknown_receiver():
     bus.register(0)
     with pytest.raises(KeyError):
         bus.route(Message("X", 0, 99))
+
+
+def test_tcp_await_peers_timeout_midframe_kills_connection():
+    """A readline that times out mid-frame leaves the buffered stream
+    desynchronized (partial bytes discarded); the backend must close the
+    connection instead of letting a retry parse the frame's tail
+    (ADVICE r2, comm/tcp.py await_peers)."""
+    import json as _json
+    import socket as _socket
+    import threading as _threading
+
+    srv = _socket.create_server(("127.0.0.1", 0))
+    host, port = srv.getsockname()
+
+    def fake_hub():
+        conn, _ = srv.accept()
+        f = conn.makefile("rb")
+        f.readline()  # registration hello
+        conn.sendall((_json.dumps({"__hub__": "ack"}) + "\n").encode())
+        f.readline()  # peers request
+        # dribble HALF a frame, then stall past the client's budget
+        conn.sendall(b'{"__hub__": "peers", "ids": [0')
+        _threading.Event().wait(2.0)
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+    t = _threading.Thread(target=fake_hub, daemon=True)
+    t.start()
+    backend = TcpBackend(7, host, port)
+    with pytest.raises(TimeoutError, match="connection closed"):
+        backend.await_peers([0, 1], timeout=0.4)
+    # the desynced socket is unusable from now on — no silent corruption
+    with pytest.raises(OSError):
+        backend.send_message(Message("X", 7, 0))
+    assert backend._stopped.is_set()
+    srv.close()

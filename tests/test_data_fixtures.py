@@ -497,3 +497,204 @@ def test_edge_case_pickle_and_label_flip_semantics(tmp_path):
     tr, te = synthetic_ood_images((32, 32, 3), num_train=8, num_test=3)
     pd2 = make_edge_case_backdoor(ds, tr, te, num_poison=100, num_clean=400)
     assert len(pd2.train_x) == 300 + 8  # capped at what exists
+
+
+# ---------------------------------------------------------------------------
+# Real image-format parsers (VERDICT r2 #3): JPEG folder trees and CSV
+# user-maps, decoded with PIL from tiny generated fixtures.
+# ---------------------------------------------------------------------------
+
+
+def _write_jpeg(path, rgb, size):
+    from PIL import Image
+
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    arr = np.full((size, size, 3), rgb, np.uint8)
+    Image.fromarray(arr).save(path, "JPEG", quality=95)
+
+
+def test_imagenet_folder_tree(tmp_path):
+    """Reference on-disk format: train/<class>/*.jpg + val/<class>/*.jpg
+    (ImageNet/datasets.py:92-97), classes sorted alphabetically, clients
+    = contiguous class blocks (data_loader.py:154-162)."""
+    from fedml_tpu.data.imagenet import load_imagenet
+
+    root = tmp_path / "ImageNet"
+    # deliberately unsorted creation order; scanner must sort
+    for cls, rgb in [("n02", (0, 255, 0)), ("n01", (255, 0, 0)),
+                     ("n03", (0, 0, 255))]:
+        for i in range(3):
+            _write_jpeg(str(root / "train" / cls / f"img_{i}.jpg"), rgb, 16)
+        _write_jpeg(str(root / "val" / cls / "v_0.jpg"), rgb, 16)
+
+    ds = load_imagenet(str(root), num_clients=3, image_size=8)
+    assert ds.train_x.shape == (9, 8, 8, 3)
+    assert ds.test_x.shape == (3, 8, 8, 3)
+    assert ds.num_classes == 3
+    # sorted class names → n01=0, n02=1, n03=2; contiguous blocks
+    np.testing.assert_array_equal(ds.train_y, [0, 0, 0, 1, 1, 1, 2, 2, 2])
+    assert {c: list(v) for c, v in ds.train_client_idx.items()} == {
+        0: [0, 1, 2], 1: [3, 4, 5], 2: [6, 7, 8]}
+    # n01 is red: after ImageNet normalization the red channel of class 0
+    # must exceed its green channel
+    assert ds.train_x[0, 0, 0, 0] > ds.train_x[0, 0, 0, 1]
+
+
+def test_landmarks_csv_user_map(tmp_path):
+    """Reference on-disk format: mini_gld_train_split.csv rows
+    (user_id,image_id,class) → images/<image_id>.jpg
+    (Landmarks/data_loader.py:125-161, datasets.py:46-49,
+    main_fedavg.py:170-172)."""
+    from fedml_tpu.data.imagenet import load_landmarks
+
+    root = tmp_path / "gld"
+    rows = [("7", "aaa", 0), ("3", "bbb", 1), ("7", "ccc", 2), ("3", "ddd", 1)]
+    os.makedirs(root, exist_ok=True)
+    with open(root / "mini_gld_train_split.csv", "w") as f:
+        f.write("user_id,image_id,class\n")
+        for u, img, c in rows:
+            f.write(f"{u},{img},{c}\n")
+    with open(root / "mini_gld_test.csv", "w") as f:
+        f.write("user_id,image_id,class\n0,eee,0\n")
+    for img in ("aaa", "bbb", "ccc", "ddd", "eee"):
+        _write_jpeg(str(root / "images" / f"{img}.jpg"), (128, 64, 32), 16)
+
+    ds = load_landmarks(str(root), variant="gld23k", image_size=8)
+    assert ds.train_x.shape == (4, 8, 8, 3)
+    assert ds.test_x.shape == (1, 8, 8, 3)
+    # per-user grouping in first-appearance order: user 7 rows first
+    np.testing.assert_array_equal(ds.train_client_idx[7], [0, 1])
+    np.testing.assert_array_equal(ds.train_client_idx[3], [2, 3])
+    # flat order = user 7's (aaa,ccc) then user 3's (bbb,ddd)
+    np.testing.assert_array_equal(ds.train_y, [0, 2, 1, 1])
+
+
+def test_cinic10_folder_tree(tmp_path):
+    """Reference on-disk format: ImageFolder train/ + test/
+    (cinic10/data_loader.py:218-226), normalized with the CINIC
+    constants like the npz path."""
+    from fedml_tpu.data.cifar import CINIC10_MEAN, CINIC10_STD, load_cinic10
+
+    root = tmp_path / "cinic10"
+    classes = [f"c{i}" for i in range(10)]
+    for ci, cls in enumerate(classes):
+        rgb = (25 * ci, 10 + ci, 200 - ci)
+        for i in range(2):
+            _write_jpeg(str(root / "train" / cls / f"t{i}.jpg"), rgb, 32)
+        _write_jpeg(str(root / "test" / cls / "e.jpg"), rgb, 32)
+
+    ds = load_cinic10(str(root), num_clients=2, partition="homo")
+    assert ds.train_x.shape == (20, 32, 32, 3)
+    assert ds.test_x.shape == (10, 32, 32, 3)
+    assert ds.num_classes == 10
+    np.testing.assert_array_equal(np.sort(np.unique(ds.train_y)), np.arange(10))
+    # normalization matches the pickle path: pixel (0,0) of class 0
+    # (rgb 0,10,200) must equal ((v/255)-mean)/std within JPEG tolerance
+    expect_b = ((200 / 255.0) - CINIC10_MEAN[2]) / CINIC10_STD[2]
+    got_b = ds.train_x[list(ds.train_y).index(0), 0, 0, 2]
+    assert abs(got_b - expect_b) < 0.15  # JPEG is lossy
+
+
+# ---------------------------------------------------------------------------
+# Raw tabular pipelines (VERDICT r2 #7): lending-club loan.csv feature
+# engineering and the NUS-WIDE Groundtruth/Features/Tags tree.
+# ---------------------------------------------------------------------------
+
+
+def test_lending_club_raw_csv(tmp_path):
+    """Full reference pipeline (lending_club_dataset.py:100-123): target
+    from loan_status, composite annual income, issue_year==2018 filter,
+    categorical maps, fillna(-99), standardization, party split."""
+    from fedml_tpu.data.tabular import (LOAN_ALL_FEATURES, LOAN_PARTY_A_DIM,
+                                        load_lending_club)
+
+    root = tmp_path / "lending_club_loan"
+    os.makedirs(root)
+    cols = ["loan_status", "issue_d", "annual_inc", "annual_inc_joint",
+            "verification_status", "verification_status_joint",
+            "grade", "emp_length", "home_ownership", "term",
+            "initial_list_status", "purpose", "application_type",
+            "disbursement_method", "loan_amnt", "int_rate", "dti"]
+    rows = [
+        # kept: 2018, Good, joint statuses match -> joint income used
+        ["Fully Paid", "Mar-2018", "50000", "90000",
+         "Verified", "Verified", "A", "10+ years", "RENT", " 36 months",
+         "w", "credit_card", "Joint App", "Cash", "10000", "11.5", "20.1"],
+        # kept: 2018, Bad (Charged Off), no joint status
+        ["Charged Off", "Jan-2018", "30000", "",
+         "Not Verified", "", "G", "< 1 year", "OWN", " 60 months",
+         "f", "small_business", "Individual", "DirectPay", "5000", "25.0",
+         ""],
+        # dropped by the issue_year==2018 filter
+        ["Fully Paid", "Dec-2017", "40000", "", "Verified", "", "B",
+         "5 years", "MORTGAGE", " 36 months", "w", "car", "Individual",
+         "Cash", "8000", "9.0", "10.0"],
+    ]
+    with open(root / "loan.csv", "w") as f:
+        f.write(",".join(cols) + "\n")
+        for r in rows:
+            f.write(",".join(r) + "\n")
+
+    x, y, splits = load_lending_club(str(root), num_hosts=1)
+    assert x.shape == (2, len(LOAN_ALL_FEATURES))  # 2017 row filtered out
+    np.testing.assert_array_equal(y, [0, 1])       # Good=0, Bad=1
+    # guest prefix = qualification+loan features, host the rest
+    assert splits[0] == slice(0, LOAN_PARTY_A_DIM)
+    assert splits[1] == slice(LOAN_PARTY_A_DIM, len(LOAN_ALL_FEATURES))
+    # standardized columns: zero mean; zero-variance cols exactly 0
+    np.testing.assert_allclose(x.mean(axis=0), 0.0, atol=1e-6)
+    # grade A(6) vs G(0) digitized then standardized -> +1/-1 over 2 rows
+    gi = LOAN_ALL_FEATURES.index("grade")
+    np.testing.assert_allclose(x[:, gi], [1.0, -1.0], atol=1e-6)
+    # annual_inc_comp row 0 used the JOINT income (90000 > 30000)
+    ai = LOAN_ALL_FEATURES.index("annual_inc_comp")
+    assert x[0, ai] > x[1, ai]
+    # dti missing in row 1 -> filled with -99 (below row 0's value)
+    di = LOAN_ALL_FEATURES.index("dti")
+    assert x[1, di] < x[0, di]
+
+
+def test_nus_wide_raw_tree(tmp_path):
+    """Reference raw layout (nus_wide_dataset.py:8-62): AllLabels counts
+    for top-k, TrainTestLabels 0/1 columns with the exactly-one filter,
+    space-separated normalized features with a trailing NaN column,
+    tab-separated 1k tags."""
+    from fedml_tpu.data.tabular import load_nus_wide
+
+    root = tmp_path / "NUS_WIDE"
+    (root / "Groundtruth" / "AllLabels").mkdir(parents=True)
+    (root / "Groundtruth" / "TrainTestLabels").mkdir(parents=True)
+    (root / "Low_Level_Features").mkdir()
+    (root / "NUS_WID_Tags").mkdir()
+
+    # label popularity: sky(3) > water(2) > dog(1) -> top-2 = sky, water
+    for label, n_pos in [("sky", 3), ("water", 2), ("dog", 1)]:
+        vals = [1] * n_pos + [0] * (6 - n_pos)
+        np.savetxt(root / "Groundtruth" / "AllLabels" /
+                   f"Labels_{label}.txt", vals, fmt="%d")
+    # 6 rows; rows 0,4 fire BOTH labels -> dropped by exactly-one filter
+    sky_rows = [1, 1, 0, 0, 1, 0]
+    water_rows = [1, 0, 1, 0, 1, 0]
+    np.savetxt(root / "Groundtruth" / "TrainTestLabels" /
+               "Labels_sky_Train.txt", sky_rows, fmt="%d")
+    np.savetxt(root / "Groundtruth" / "TrainTestLabels" /
+               "Labels_water_Train.txt", water_rows, fmt="%d")
+    rng = np.random.RandomState(0)
+    # two feature blocks (3 + 2 cols); trailing space -> NaN last column
+    for fname, d in [("Train_Normalized_CH.dat", 3),
+                     ("Train_Normalized_EDH.dat", 2)]:
+        with open(root / "Low_Level_Features" / fname, "w") as f:
+            for _ in range(6):
+                f.write(" ".join(f"{v:.4f}" for v in rng.rand(d)) + " \n")
+    with open(root / "NUS_WID_Tags" / "Train_Tags1k.dat", "w") as f:
+        for _ in range(6):
+            f.write("\t".join(str(int(v)) for v in rng.rand(4) > 0.5) + "\n")
+
+    x, y, splits = load_nus_wide(str(root))
+    # rows kept: 1 (sky only) and 2 (water only) — rows firing both or
+    # neither are dropped by the exactly-one filter (sum(axis=1) == 1)
+    assert x.shape == (2, 3 + 2 + 4)
+    assert splits[0] == slice(0, 5) and splits[1] == slice(5, 9)
+    # y = first selected label (sky, the most popular) fires
+    np.testing.assert_array_equal(y, [1, 0])
+    np.testing.assert_allclose(x.mean(axis=0), 0.0, atol=1e-6)

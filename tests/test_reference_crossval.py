@@ -20,9 +20,13 @@ Covered here:
   users and the same per-user arrays.
 
 The reference tree is read-only PUBLIC content; these tests execute its
-self-contained numpy/torch modules solely to generate oracles.
+self-contained numpy/torch modules solely to generate oracles.  Every
+file executed here is pinned by content hash (ADVICE r2): if the tree
+under /root/reference changes, the test SKIPS instead of running
+unreviewed public code in the gating tier.
 """
 
+import hashlib
 import importlib.util
 import json
 import os
@@ -33,15 +37,50 @@ import pytest
 
 REF = "/root/reference"
 
+# sha256 of every reference file this module executes, pinned at review
+# time — exec of public content is deliberate, exec of *changed* public
+# content is not.
+PINNED_SHA256 = {
+    "fedml_core/non_iid_partition/noniid_partition.py":
+        "71377e4975c74f532a1727a129c907daa91501a8f51500b1cdf43d715955b00d",
+    "fedml_api/model/cv/resnet.py":
+        "9b561ec4bc9e909d40c724c7277cb56cd90a2d8d1c9cf3c7795d34ba882947e2",
+    "fedml_api/model/cv/cnn.py":
+        "797bf49e8e1f24f48fa67375d91b3a1f263ade7d94fd45a4cdeb7cbf94a60042",
+    "fedml_api/model/linear/lr.py":
+        "e691b388b91220c975a9409bad22850f132bb21064dad86435a6f36523dd8779",
+    "fedml_api/model/nlp/rnn.py":
+        "dd9e65ea646628eab473d13fd7dd4d87d60d3e514fc3b981747c3e59fe450869",
+    "fedml_api/data_preprocessing/MNIST/data_loader.py":
+        "f0cbf9942783fb053fa437946641468dd40008a948e3f40f190cb36e97191a00",
+    "fedml_api/data_preprocessing/cifar10/data_loader.py":
+        "9d4a0fe68b256016bc5ce4604df11646cb077f8c9d9af1e5ef7131b785a6c86b",
+}
 
-def _load_ref(name, relpath):
+
+def _pinned_source(relpath: str) -> str:
+    """Read a reference file for execution, enforcing the pinned hash."""
     path = os.path.join(REF, relpath)
     if not os.path.exists(path):
         pytest.skip(f"reference file missing: {relpath}")
+    src = open(path, "rb").read()
+    digest = hashlib.sha256(src).hexdigest()
+    if digest != PINNED_SHA256[relpath]:
+        pytest.skip(
+            f"reference file {relpath} changed (sha256 {digest[:12]}… != "
+            f"pinned {PINNED_SHA256[relpath][:12]}…); refusing to execute "
+            "unreviewed public content — re-pin after review"
+        )
+    return src.decode()
+
+
+def _load_ref(name, relpath):
+    path = os.path.join(REF, relpath)
+    src = _pinned_source(relpath)
     spec = importlib.util.spec_from_file_location(name, path)
     mod = importlib.util.module_from_spec(spec)
     sys.modules[name] = mod
-    spec.loader.exec_module(mod)
+    exec(compile(src, path, "exec"), mod.__dict__)
     return mod
 
 
@@ -267,9 +306,9 @@ def test_cutout_matches_extracted_reference():
     path = os.path.join(
         REF, "fedml_api/data_preprocessing/cifar10/data_loader.py"
     )
-    if not os.path.exists(path):
-        pytest.skip("reference file missing")
-    tree = ast.parse(open(path).read())
+    tree = ast.parse(
+        _pinned_source("fedml_api/data_preprocessing/cifar10/data_loader.py")
+    )
     node = next(
         n for n in tree.body
         if isinstance(n, ast.ClassDef) and n.name == "Cutout"

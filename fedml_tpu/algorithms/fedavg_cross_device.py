@@ -46,7 +46,19 @@ SERVER = 0
 
 
 class FedAvgServerManager(NodeManager):
-    """Rank-0 coordinator: sample → broadcast → collect → aggregate."""
+    """Rank-0 coordinator: sample → broadcast → collect → aggregate.
+
+    Straggler tolerance (beyond the reference, whose server waits
+    forever and whose only failure path is ``MPI.COMM_WORLD.Abort()``,
+    ``server_manager.py:55-58``): with ``round_timeout`` set, a round
+    that hasn't gathered its full cohort by the deadline aggregates
+    with whoever arrived — the sample-weighted average is well-defined
+    over any non-empty subset, exactly the compiled engine's
+    participation-mask semantics (``core/sampling.py inject_dropout``) —
+    and the dropouts are logged.  Replies carry their round index, so a
+    straggler's late upload from a closed round is discarded instead of
+    corrupting the next aggregation.
+    """
 
     def __init__(
         self,
@@ -58,7 +70,10 @@ class FedAvgServerManager(NodeManager):
         comm_rounds: int,
         seed: int = 0,
         steps_per_epoch: Optional[int] = None,
+        round_timeout: Optional[float] = None,
     ):
+        import threading
+
         # cohort-wide pack geometry: shipped to clients so a client's
         # fixed-shape pack is IDENTICAL to its slice of the simulation's
         # cohort pack (heterogeneous sizes would otherwise change batch
@@ -72,6 +87,13 @@ class FedAvgServerManager(NodeManager):
         self.round_idx = 0
         self.pending: Dict[int, dict] = {}
         self.round_log = []
+        self.round_timeout = round_timeout
+        # _on_model runs on the backend reader thread, the deadline on a
+        # Timer thread: one lock serializes round completion, and the
+        # timer is generation-checked so a stale deadline (its round
+        # completed normally) is a no-op
+        self._round_lock = threading.Lock()
+        self._deadline_timer: Optional[threading.Timer] = None
         super().__init__(backend)
 
     def register_message_receive_handlers(self):
@@ -86,6 +108,31 @@ class FedAvgServerManager(NodeManager):
             self.send_message(
                 self._model_msg(MSG_TYPE_S2C_INIT_CONFIG, node, node - 1, wire)
             )
+        self._arm_deadline()
+
+    def _arm_deadline(self):
+        if self.round_timeout is None:
+            return
+        import threading
+
+        t = threading.Timer(
+            self.round_timeout, self._on_deadline, args=(self.round_idx,)
+        )
+        t.daemon = True
+        self._deadline_timer = t
+        t.start()
+
+    def _on_deadline(self, round_gen: int):
+        with self._round_lock:
+            if round_gen != self.round_idx or self.round_idx >= self.comm_rounds:
+                return  # stale timer: that round already closed
+            if not self.pending:
+                # nobody arrived: the global model is unchanged, the
+                # round still closes (an all-dropped round under the
+                # mask semantics is a no-op update)
+                self._close_round(dropped_all=True)
+                return
+            self._close_round()
 
     def _sampled_nodes(self):
         """Seeded uniform sampling every round (the fork's hardcoded
@@ -110,25 +157,49 @@ class FedAvgServerManager(NodeManager):
         return m
 
     def _on_model(self, msg: Message):
-        self.pending[msg.sender] = {
-            "variables": tree_from_wire(
-                msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.variables
-            ),
-            "n": msg.get(MSG_ARG_KEY_NUM_SAMPLES),
-            "metrics": msg.get(MSG_ARG_KEY_LOCAL_METRICS) or {},
-        }
-        if len(self.pending) < self.clients_per_round:
-            return
-        # aggregate: sample-weighted average (FedAVGAggregator.py:58-87)
-        entries = list(self.pending.values())
-        total = sum(e["n"] for e in entries)
-        self.variables = treelib.tree_weighted_sum(
-            [e["variables"] for e in entries],
-            [e["n"] / total for e in entries],
-        )
-        self.round_log.append(
-            {"round": self.round_idx, "participants": sorted(self.pending)}
-        )
+        with self._round_lock:
+            # discard a straggler's upload from an already-closed round:
+            # aggregating it into the CURRENT round would double-count
+            # its stale parameters (missing round index = legacy client,
+            # accepted as current)
+            reply_round = msg.get(MSG_ARG_KEY_ROUND_INDEX)
+            if reply_round is not None and reply_round != self.round_idx:
+                self.round_log.append(
+                    {"round": self.round_idx, "stale_from": msg.sender,
+                     "stale_round": reply_round}
+                )
+                return
+            self.pending[msg.sender] = {
+                "variables": tree_from_wire(
+                    msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.variables
+                ),
+                "n": msg.get(MSG_ARG_KEY_NUM_SAMPLES),
+                "metrics": msg.get(MSG_ARG_KEY_LOCAL_METRICS) or {},
+            }
+            if len(self.pending) < self.clients_per_round:
+                return
+            self._close_round()
+
+    def _close_round(self, dropped_all: bool = False):
+        """Aggregate whatever arrived and advance (caller holds the
+        round lock).  Weighted average over any non-empty subset ==
+        the compiled round's participation-mask aggregation."""
+        if self._deadline_timer is not None:
+            self._deadline_timer.cancel()
+        sampled = set(self._sampled_nodes())
+        if not dropped_all:
+            # aggregate: sample-weighted average (FedAVGAggregator.py:58-87)
+            entries = list(self.pending.values())
+            total = sum(e["n"] for e in entries)
+            self.variables = treelib.tree_weighted_sum(
+                [e["variables"] for e in entries],
+                [e["n"] / total for e in entries],
+            )
+        rec = {"round": self.round_idx, "participants": sorted(self.pending)}
+        dropped = sorted(sampled - set(self.pending))
+        if dropped:
+            rec["dropped"] = dropped  # deadline expired without them
+        self.round_log.append(rec)
         self.pending.clear()
         self.round_idx += 1
         if self.round_idx >= self.comm_rounds:
@@ -141,6 +212,7 @@ class FedAvgServerManager(NodeManager):
             self.send_message(
                 self._model_msg(MSG_TYPE_S2C_SYNC_MODEL, node, node - 1, wire)
             )
+        self._arm_deadline()
 
 
 class FedAvgClientManager(NodeManager):
@@ -155,6 +227,7 @@ class FedAvgClientManager(NodeManager):
         batch_size: int,
         template_variables,
         seed: int = 0,
+        train_delay: float = 0.0,
     ):
         self.local_update = jax.jit(local_update.fn)
         self.dataset = dataset
@@ -162,6 +235,9 @@ class FedAvgClientManager(NodeManager):
         self.template = template_variables
         self.seed = seed
         self.rounds_trained = 0
+        # artificial pre-training sleep: straggler injection for the
+        # server's round-deadline path (tests/test_distributed_process)
+        self.train_delay = train_delay
         super().__init__(backend)
 
     def register_message_receive_handlers(self):
@@ -170,6 +246,10 @@ class FedAvgClientManager(NodeManager):
         self.register_message_receive_handler(MSG_TYPE_S2C_FINISH, self._on_finish)
 
     def _on_sync(self, msg: Message):
+        if self.train_delay:
+            import time
+
+            time.sleep(self.train_delay)
         variables = tree_from_wire(msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.template)
         client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
         round_idx = msg.get(MSG_ARG_KEY_ROUND_INDEX)
@@ -195,6 +275,8 @@ class FedAvgClientManager(NodeManager):
         )
         self.rounds_trained += 1
         reply = Message(MSG_TYPE_C2S_SEND_MODEL, self.backend.node_id, SERVER)
+        # echo the round: the server rejects uploads from closed rounds
+        reply.add_params(MSG_ARG_KEY_ROUND_INDEX, round_idx)
         reply.add_params(MSG_ARG_KEY_MODEL_PARAMS, tree_to_wire(new_vars))
         reply.add_params(MSG_ARG_KEY_NUM_SAMPLES, float(pack.num_samples[0]))
         reply.add_params(

@@ -144,15 +144,17 @@ def load_cinic10(
         tr_paths, tr_y, classes = scan_class_tree(
             os.path.join(data_dir, "train")
         )
+        tr_x = decode_images(tr_paths, 32, CINIC10_MEAN, CINIC10_STD)
         te_dir = os.path.join(data_dir, "test")
-        te_paths, te_y, _ = (
-            scan_class_tree(te_dir) if os.path.isdir(te_dir)
-            else (tr_paths[:64], tr_y[:64], classes)
-        )
-        arrays = (
-            decode_images(tr_paths, 32, CINIC10_MEAN, CINIC10_STD), tr_y,
-            decode_images(te_paths, 32, CINIC10_MEAN, CINIC10_STD), te_y,
-        )
+        if os.path.isdir(te_dir):
+            te_paths, te_y, _ = scan_class_tree(te_dir)
+            te_x = decode_images(te_paths, 32, CINIC10_MEAN, CINIC10_STD)
+        else:
+            # strided slice across the class-grouped walk (a [:64] prefix
+            # would be a one-class test set), reusing decoded rows
+            sel = np.linspace(0, len(tr_y) - 1, min(64, len(tr_y))).astype(int)
+            te_x, te_y = tr_x[sel], tr_y[sel]
+        arrays = (tr_x, tr_y, te_x, te_y)
         return _build(arrays, CINIC10_MEAN, CINIC10_STD, 10, "cinic10",
                       num_clients, partition, partition_alpha, seed,
                       (5000, 1000), normalized=True)

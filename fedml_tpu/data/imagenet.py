@@ -73,15 +73,20 @@ def _from_folder_tree(
     train_paths, train_y, classes = scan_class_tree(
         os.path.join(data_dir, "train"), max_per_class=max_per_class
     )
+    train_x = decode_images(train_paths, image_size, mean, std)
     test_root = os.path.join(data_dir, test_subdir)
     if os.path.isdir(test_root):
         test_paths, test_y, _ = scan_class_tree(
             test_root, max_per_class=max_per_class
         )
+        test_x = decode_images(test_paths, image_size, mean, std)
     else:
-        test_paths, test_y = train_paths[:64], train_y[:64]
-    train_x = decode_images(train_paths, image_size, mean, std)
-    test_x = decode_images(test_paths, image_size, mean, std)
+        # no val/ tree: a STRIDED slice of the class-grouped train walk
+        # (paths[:64] would be one class — accuracy on it is meaningless)
+        # reusing the already-decoded rows
+        sel = np.linspace(0, len(train_y) - 1,
+                          min(64, len(train_y))).astype(int)
+        test_x, test_y = train_x[sel], train_y[sel]
     num_classes = len(classes)
     return FedDataset(
         train_x=train_x, train_y=train_y, test_x=test_x, test_y=test_y,
@@ -122,13 +127,29 @@ def _from_user_map_csv(
     """The reference's Landmarks on-disk format: CSV rows
     ``user_id,image_id,class`` mapped to ``<data_dir>/<image_id>.jpg``
     (``Landmarks/data_loader.py:125-161``, ``datasets.py:46-49``)."""
+    import csv
+
     from fedml_tpu.data.imagefolder import (decode_images,
                                             group_rows_per_user,
                                             read_user_map_csv)
 
     rows, client_idx = group_rows_per_user(read_user_map_csv(train_map))
-    test_rows = read_user_map_csv(test_map) if os.path.exists(test_map) \
-        else rows[:64]
+    if os.path.exists(test_map):
+        # the TEST split is NOT user-partitioned: the reference reads it
+        # with a plain _read_csv and touches only image_id/class
+        # (load_partition_data_landmarks, data_loader.py:206;
+        # datasets.py:46-49) — enforce only those columns
+        with open(test_map, "r") as f:
+            test_rows = list(csv.DictReader(f))
+        if test_rows and not all(
+            c in test_rows[0] for c in ("image_id", "class")
+        ):
+            raise ValueError(
+                "test mapping must contain image_id and class columns; "
+                f"got {','.join(test_rows[0])}"
+            )
+    else:
+        test_rows = rows[:64]
 
     def arrays(rs):
         paths = [os.path.join(data_dir, f"{r['image_id']}.jpg") for r in rs]

@@ -87,10 +87,19 @@ def synthetic_classification(
     partition: str = "hetero",
     partition_alpha: float = 0.5,
     noise: float = 0.8,
+    label_noise: float = 0.0,
     seed: int = 0,
     name: str = "synthetic",
 ) -> FedDataset:
-    """Class-prototype Gaussian data with the same shapes as a real dataset."""
+    """Class-prototype Gaussian data with the same shapes as a real dataset.
+
+    ``label_noise`` = η flips that fraction of labels (train AND test,
+    independently drawn) to a uniformly random WRONG class: a model that
+    perfectly learns the clean prototypes still scores only ≈ 1−η test
+    accuracy, giving the task a documented irreducible-error ceiling —
+    saturating trajectories can't distinguish a correct FedAvg from a
+    subtly wrong one (VERDICT r2 missing #1).  Partitioning uses the
+    NOISY labels, as real noisy data would."""
     rng = np.random.RandomState(seed)
     protos = rng.normal(0, 1, (num_classes, *input_shape)).astype(np.float32)
 
@@ -98,6 +107,14 @@ def synthetic_classification(
         r = np.random.RandomState(sd)
         y = r.randint(0, num_classes, n).astype(np.int32)
         x = protos[y] + r.normal(0, noise, (n, *input_shape)).astype(np.float32)
+        if label_noise > 0.0:
+            flip = r.rand(n) < label_noise
+            # uniform over the num_classes-1 WRONG classes
+            y = np.where(
+                flip,
+                (y + 1 + r.randint(0, num_classes - 1, n)) % num_classes,
+                y,
+            ).astype(np.int32)
         return x.astype(np.float32), y
 
     train_x, train_y = make(num_train, seed + 10)

@@ -116,6 +116,7 @@ def run_server(args) -> None:
         clients_per_round=args.clients_per_round or args.num_clients,
         comm_rounds=args.rounds, seed=args.seed,
         steps_per_epoch=steps,
+        round_timeout=args.round_timeout or None,
     )
     # startup barrier: the hub drops frames to unregistered receivers,
     # so broadcasting before every client registered would hang
@@ -142,6 +143,7 @@ def run_client(args) -> None:
     FedAvgClientManager(
         backend, lu, ds, batch_size=args.batch_size,
         template_variables=init, seed=args.seed,
+        train_delay=args.train_delay,
     )
     backend.run()  # returns on FINISH
 
@@ -155,6 +157,9 @@ def launch(
     out_path: str,
     extra_idle_clients: int = 0,
     kill_idle_after: float = 0.0,
+    round_timeout: float = 0.0,
+    slow_client_delay: float = 0.0,
+    kill_slow_client_after: float = 0.0,
     env=None,
     timeout: float = 180.0,
 ):
@@ -165,7 +170,13 @@ def launch(
     ``extra_idle_clients`` registers clients beyond ``num_clients`` that
     the server never samples — one is SIGKILLed once the launcher has
     CONFIRMED its hub registration (``await_peers``), exercising the
-    hub's dead-peer handling mid-run without wedging the round."""
+    hub's dead-peer handling mid-run without wedging the round.
+
+    ``slow_client_delay`` makes the LAST sampled client (node id
+    ``num_clients``) sleep that long before each local update;
+    ``kill_slow_client_after`` SIGKILLs it mid-sleep — i.e. a SAMPLED
+    client dies mid-round.  With ``round_timeout`` set the server's
+    deadline aggregates without it and logs the dropout."""
     env = dict(env or os.environ)
     me = [sys.executable, "-m", "fedml_tpu.experiments.distributed_fedavg"]
     hub = None
@@ -183,9 +194,13 @@ def launch(
         common = ["--host", "127.0.0.1", "--port", str(port),
                   "--num-clients", str(num_clients), "--rounds", str(rounds),
                   "--seed", str(seed), "--batch-size", str(batch_size)]
+        if round_timeout:
+            common += ["--round-timeout", str(round_timeout)]
         clients = [
             subprocess.Popen(
-                me + ["--role", "client", "--node-id", str(i + 1)] + common,
+                me + ["--role", "client", "--node-id", str(i + 1)] + common
+                + (["--train-delay", str(slow_client_delay)]
+                   if slow_client_delay and i == num_clients - 1 else []),
                 env=env,
             )
             for i in range(num_clients)
@@ -205,6 +220,20 @@ def launch(
             env=env,
         )
         procs.append(server)
+        if kill_slow_client_after and slow_client_delay:
+            # wait until EVERYONE (clients + server) is registered — the
+            # server's await_peers barrier has then passed, so killing
+            # the slow client can no longer wedge startup; by now it is
+            # asleep in its first local update (train_delay) — a SAMPLED
+            # client dying mid-round
+            from fedml_tpu.comm.tcp import TcpBackend
+
+            mon = TcpBackend(9998, "127.0.0.1", port)
+            mon.await_peers([0] + list(range(1, num_clients + 1)),
+                            timeout=60)
+            mon.stop()
+            time.sleep(kill_slow_client_after)
+            clients[-1].kill()
         if idle:
             # monitor connection: wait until the doomed peer is actually
             # registered, so the kill exercises hub dead-peer cleanup
@@ -245,6 +274,10 @@ def main(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--batch-size", type=int, default=16)
     p.add_argument("--out", default="")
+    # straggler knobs: server-side round deadline (s; 0 = wait forever,
+    # the reference's behavior) and client-side artificial train delay
+    p.add_argument("--round-timeout", type=float, default=0.0)
+    p.add_argument("--train-delay", type=float, default=0.0)
     args = p.parse_args(argv)
     if args.role == "hub":
         run_hub(args.host, args.port)

@@ -192,3 +192,66 @@ def test_tcp_await_peers_timeout_midframe_kills_connection():
         backend.send_message(Message("X", 7, 0))
     assert backend._stopped.is_set()
     srv.close()
+
+
+def test_server_deadline_zero_arrivals_and_stale_reply():
+    """Round-deadline edges, inproc: (a) a deadline with ZERO arrivals
+    closes the round with the global model unchanged; (b) a straggler's
+    upload stamped with a closed round index is rejected, not folded
+    into the current aggregation."""
+    import time
+
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.fedavg_cross_device import FedAvgServerManager
+    from fedml_tpu.comm.inproc import InprocBus
+    from fedml_tpu.comm.message import (MSG_ARG_KEY_MODEL_PARAMS,
+                                        MSG_ARG_KEY_NUM_SAMPLES,
+                                        MSG_ARG_KEY_ROUND_INDEX,
+                                        MSG_TYPE_C2S_SEND_MODEL,
+                                        tree_to_wire)
+
+    bus = InprocBus()
+    server_backend = bus.register(0)
+    for i in (1, 2):
+        bus.register(i)  # silent clients: never reply
+    init = {"params": {"w": jnp.ones((2, 2))}}
+    server = FedAvgServerManager(
+        server_backend, init, num_clients=2, clients_per_round=2,
+        comm_rounds=3, seed=0, round_timeout=0.15,
+    )
+    server.start()
+    time.sleep(0.4)  # deadline fires with nobody arrived
+    assert server.round_idx >= 1
+    rec = server.round_log[0]
+    assert rec["participants"] == [] and rec["dropped"] == [1, 2]
+    np.testing.assert_array_equal(
+        np.asarray(server.variables["params"]["w"]), np.ones((2, 2))
+    )
+
+    # stale reply: stamped round 0, but that round is closed.  Disarm
+    # the deadline first — round_idx must not advance under us between
+    # the read and the asserts (1-core box, GIL contention); the brief
+    # sleep lets any in-flight timer callback drain (cancel() cannot
+    # stop one that already started)
+    server.round_timeout = None  # _arm_deadline becomes a no-op
+    if server._deadline_timer is not None:
+        server._deadline_timer.cancel()
+    time.sleep(0.05)
+    cur = server.round_idx
+    stale = Message(MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+    stale.add_params(MSG_ARG_KEY_ROUND_INDEX, 0)
+    stale.add_params(
+        MSG_ARG_KEY_MODEL_PARAMS,
+        tree_to_wire({"params": {"w": jnp.full((2, 2), 99.0)}}),
+    )
+    stale.add_params(MSG_ARG_KEY_NUM_SAMPLES, 5.0)
+    server._on_model(stale)
+    assert server.pending == {}  # rejected, not queued
+    assert any("stale_from" in r for r in server.round_log)
+    assert server.round_idx == cur
+    np.testing.assert_array_equal(
+        np.asarray(server.variables["params"]["w"]), np.ones((2, 2))
+    )
+    if server._deadline_timer is not None:
+        server._deadline_timer.cancel()

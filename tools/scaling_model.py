@@ -1,0 +1,200 @@
+"""Quantitative 8→256-chip scaling model with measured inputs
+(VERDICT r2 next #5).
+
+The 1-core CPU box cannot measure ICI, so the r2 chips-mode ladder's
+"efficiency" numbers were harness validation only.  This tool replaces
+them with a MODEL whose every input is either measured on the real chip
+or a cited hardware constant:
+
+- ``t_compute``: measured seconds/round of the north-star workload on
+  ONE v5e chip via the fused driver (``bench.py`` protocol: warmup to
+  agreement, median, scalar readback inside the timed window).  This
+  already CONTAINS the on-chip partial aggregation (the einsum over the
+  local client axis) and the optimizer/server update.
+- ``payload_bytes``: the exact fp32 byte size of the aggregated
+  variable tree (params + BN stats), counted from the model's pytree.
+- ``ici_bw``: v5e per-link one-way ICI bandwidth, 4.5e10 B/s, 2D torus
+  up to 16x16 = 256 chips (public v5e spec / jax-ml scaling book).  The
+  model conservatively uses ONE axis, ONE direction — a real 2D
+  bidirectional torus is up to 4x faster.
+- ``hop_latency``: 1 us/hop, ring diameter N/2 hops — also conservative
+  (ICI hop latency is sub-microsecond).
+
+Weak-scaling scenario (SURVEY.md §7.8 north star): clients-per-chip
+fixed, chips grow; per round each chip trains its resident clients
+(t_compute, constant) then joins ONE all-reduce of the variable tree
+(``lax.psum`` over the ``clients`` mesh axis — ``parallel/spmd.py``).
+
+    t_allreduce(N) = 2 * V * (N-1)/N / ici_bw  +  (N/2) * hop_latency
+    efficiency(N)  = t_compute / (t_compute + t_allreduce(N))
+
+The communication/compute ratio is what makes federated rounds scale:
+one 2.4 MB all-reduce amortized over E local epochs of ResNet-56
+training (~540 ms) is a ~4e-4 overhead — efficiency stays >99% through
+256 chips even with the conservative single-axis model.  Cross-host DCN
+(beyond one 256-chip slice) at 2.5e10 B/s/host raises it to ~2e-4 s,
+still >99%.
+
+Usage: python tools/scaling_model.py [--measure] [--out SCALING_r03.json]
+  --measure re-times the workload on the local chip (else uses
+  --t-compute, default = the r3 bench measurement).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+V5E_ICI_BW = 4.5e10          # B/s, per link, one way (scaling-book v5e)
+V5E_DCN_BW = 2.5e10          # B/s per host NIC, conservative
+HOP_LATENCY = 1e-6           # s/hop, conservative
+
+
+def payload_bytes():
+    import jax
+    import numpy as np
+
+    from fedml_tpu.models.resnet import resnet56
+
+    bundle = resnet56(num_classes=10)
+    shapes = jax.eval_shape(lambda k: bundle.init(k), jax.random.PRNGKey(0))
+    return int(sum(np.prod(l.shape) * 4  # fp32 aggregation masters
+                   for l in jax.tree_util.tree_leaves(shapes)))
+
+
+def measure_t_compute():
+    """bench.py's exact workload + timing protocol, returning s/round."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+    from fedml_tpu.algorithms.fedavg import (ServerState, make_multi_round_fn,
+                                             resolve_compute_dtype)
+    from fedml_tpu.core.client import make_client_optimizer, make_local_update
+    from fedml_tpu.models.resnet import resnet56
+    from fedml_tpu.utils.timing import measure_rounds
+
+    bundle = resnet56(num_classes=10)
+    lu = make_local_update(
+        bundle, make_client_optimizer("sgd", 0.001, momentum=0.9,
+                                      weight_decay=0.001),
+        epochs=1, compute_dtype=resolve_compute_dtype("bf16"), unroll=4,
+    )
+    rpc = 40
+    round_fn = jax.jit(make_multi_round_fn(lu, rpc))
+    rng = np.random.RandomState(0)
+    C, S, B = 10, 24, 64
+    args_ = (
+        jnp.asarray(rng.rand(C, S, B, 32, 32, 3).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 10, (C, S, B)).astype(np.int32)),
+        jnp.ones((C, S, B), jnp.float32),
+        jnp.full((C,), S * B, jnp.float32),
+        jnp.ones((C,), jnp.float32),
+        jnp.arange(C, dtype=jnp.int32),
+    )
+    key = jax.random.PRNGKey(0)
+    state = ServerState(variables=bundle.init(key), opt_state=(),
+                        round_idx=jnp.zeros((), jnp.int32), key=key)
+    med, _ = measure_rounds(round_fn, state, args_, 3)
+    return med / rpc
+
+
+def model_efficiency(t_compute: float, v_bytes: int, n: int,
+                     bw: float = V5E_ICI_BW) -> dict:
+    t_ar = 2.0 * v_bytes * (n - 1) / n / bw + (n / 2) * HOP_LATENCY
+    return {
+        "chips": n,
+        "t_allreduce_ms": round(t_ar * 1e3, 4),
+        "round_time_s": round(t_compute + t_ar, 5),
+        "efficiency": round(t_compute / (t_compute + t_ar), 5),
+    }
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--measure", action="store_true",
+                   help="re-time the workload on the local real chip")
+    p.add_argument("--t-compute", type=float, default=0.5407,
+                   help="s/round on one chip (bench r3: 28,404 samples/s "
+                   "over 15,360 samples/round)")
+    p.add_argument("--out", default="SCALING_r03.json")
+    p.add_argument("--merge", default="SCALING_r02.json",
+                   help="carry over the measured clients-per-chip ladder")
+    args = p.parse_args()
+
+    t_compute = measure_t_compute() if args.measure else args.t_compute
+    v = payload_bytes()
+
+    chips = [model_efficiency(t_compute, v, n) for n in (8, 64, 256)]
+    dcn = model_efficiency(t_compute, v, 1024, bw=V5E_DCN_BW)
+    dcn["note"] = ("multi-slice via DCN (beyond one 256-chip v5e torus), "
+                   "per-host NIC bandwidth, same formula")
+
+    artifact = {
+        "round": 3,
+        "model": {
+            "scenario": "weak scaling, north-star cross-silo FedAvg: "
+                        "fixed clients/chip, one psum all-reduce of the "
+                        "variable tree per round (parallel/spmd.py)",
+            "inputs": {
+                "t_compute_s_per_round": t_compute,
+                "t_compute_source": "measured, one real v5e chip, fused "
+                                    "driver (bench.py protocol; includes "
+                                    "on-chip aggregation + optimizer)",
+                "payload_bytes": v,
+                "payload_source": "fp32 byte size of the aggregated "
+                                  "resnet56 variable tree (params + BN "
+                                  "stats), counted from the pytree",
+                "ici_bw_bytes_per_s": V5E_ICI_BW,
+                "ici_source": "v5e per-link one-way ICI (scaling book); "
+                              "model uses ONE axis ONE direction of the "
+                              "2D torus — conservative by up to 4x",
+                "hop_latency_s": HOP_LATENCY,
+            },
+            "formula": "eff(N) = t_c / (t_c + 2V(N-1)/(N*BW) + N/2*lat)",
+            "points": chips,
+            "dcn_point": dcn,
+            "headline": {
+                "comm_compute_ratio_at_256": round(
+                    chips[-1]["t_allreduce_ms"] / 1e3 / t_compute, 6
+                ),
+                "claim": ">=90% weak-scaling efficiency 8->256 chips "
+                         "holds with >10x margin: one small all-reduce "
+                         "per E-epoch round is ~4e-4 of round time",
+            },
+        },
+    }
+    if os.path.exists(args.merge):
+        prior = json.load(open(args.merge))
+        kept = []
+        for pt in prior.get("points", []):
+            if pt.get("metric") == "clients_per_chip_throughput":
+                kept.append(pt)  # measured on the real chip in r2
+            elif pt.get("metric") == "weak_scaling_round_time":
+                pt["note"] = ("faked CPU mesh: validates the shard_map "
+                              "harness ONLY; its efficiency numbers are "
+                              "1-core timeslicing, NOT an ICI claim — "
+                              "see model section")
+                pt.pop("efficiency", None)
+                kept.append(pt)
+        artifact["measured"] = {
+            "source": "SCALING_r02.json (real-chip clients ladder; CPU "
+                      "harness rows de-fanged)",
+            "points": kept,
+        }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1)
+    print(json.dumps({"out": args.out, "t_compute": t_compute,
+                      "payload_bytes": v,
+                      "eff": {c["chips"]: c["efficiency"] for c in chips}}))
+
+
+if __name__ == "__main__":
+    main()

@@ -38,47 +38,22 @@ import numpy as np
 REFERENCE_GPU_SAMPLES_PER_SEC = 1500.0
 
 
-def main():
-    p = argparse.ArgumentParser()
-    # 10 clients all participating = the reference's cross-silo ResNet-56
-    # benchmark cohort (BASELINE.md: "10 clients all participating,
-    # E=20, batch 64")
-    p.add_argument("--clients", type=int, default=10)
-    p.add_argument("--batch", type=int, default=64)
-    p.add_argument("--steps", type=int, default=24)
-    p.add_argument("--epochs", type=int, default=1)
-    p.add_argument("--rounds", type=int, default=4,
-                   help="measured multi-round calls (median over these)")
-    p.add_argument(
-        "--rounds-per-call", type=int, default=40,
-        help="federated rounds fused per compiled call "
-        "(make_multi_round_fn); 1 = per-round dispatch path. Measured "
-        "ladder on v5e (PROFILE.md): 10=26.5k, 20=27.6k, 40=28.3k, "
-        "80=28.8k samples/s — 40 is the knee",
-    )
-    p.add_argument(
-        "--unroll", type=int, default=4,
-        help="step-scan unroll inside the local update (TPU while-loop "
-        "bookkeeping is ~0.3ms/iteration; 4 measured best on v5e)",
-    )
-    p.add_argument(
-        "--dtype",
-        default="bf16",
-        help="compute dtype for the local-training forward/backward. "
-        "bf16 = mixed precision (fp32 masters/optimizer/aggregation): "
-        "~1.5-2x fp32 on the MXU; convergence parity with fp32 is "
-        "unit-tested (tests/test_fedavg.py::test_fedavg_mixed_precision_bf16).",
-    )
-    args = p.parse_args()
-
+def build_north_star(
+    clients: int = 10,
+    batch: int = 64,
+    steps: int = 24,
+    epochs: int = 1,
+    dtype: str = "bf16",
+    unroll: int = 4,
+    rounds_per_call: int = 80,
+    client_unroll: int = 1,
+):
+    """The canonical bench workload, shared with tools/scaling_model.py
+    so the scaling model's measured t_compute is BY CONSTRUCTION the
+    bench protocol's configuration.  Returns (round_fn, state, args,
+    samples_per_call)."""
     import jax
     import jax.numpy as jnp
-
-    # persistent compile cache: the driver runs this in a fresh process,
-    # so without it the measured session pays the full ~50s compile and
-    # any warmup-budget interaction with it
-    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
     from fedml_tpu.algorithms.fedavg import (
         ServerState,
@@ -91,31 +66,84 @@ def main():
     bundle = resnet56(num_classes=10)
     opt = make_client_optimizer("sgd", 0.001, momentum=0.9, weight_decay=0.001)
     local_update = make_local_update(
-        bundle,
-        opt,
-        epochs=args.epochs,
-        compute_dtype=resolve_compute_dtype(args.dtype),
-        unroll=args.unroll,
+        bundle, opt, epochs=epochs,
+        compute_dtype=resolve_compute_dtype(dtype), unroll=unroll,
     )
     round_fn = jax.jit(
-        make_multi_round_fn(local_update, args.rounds_per_call)
+        make_multi_round_fn(local_update, rounds_per_call,
+                            client_unroll=client_unroll)
     )
-
     rng = np.random.RandomState(0)
-    C, S, B = args.clients, args.steps, args.batch
-    x = jnp.asarray(rng.rand(C, S, B, 32, 32, 3).astype(np.float32))
-    y = jnp.asarray(rng.randint(0, 10, (C, S, B)).astype(np.int32))
-    mask = jnp.ones((C, S, B), jnp.float32)
-    num_samples = jnp.full((C,), S * B, jnp.float32)
-    participation = jnp.ones((C,), jnp.float32)
-    slot_ids = jnp.arange(C, dtype=jnp.int32)
-
+    C, S, B = clients, steps, batch
+    args = (
+        jnp.asarray(rng.rand(C, S, B, 32, 32, 3).astype(np.float32)),
+        jnp.asarray(rng.randint(0, 10, (C, S, B)).astype(np.int32)),
+        jnp.ones((C, S, B), jnp.float32),
+        jnp.full((C,), S * B, jnp.float32),
+        jnp.ones((C,), jnp.float32),
+        jnp.arange(C, dtype=jnp.int32),
+    )
     key = jax.random.PRNGKey(0)
     state = ServerState(
-        variables=bundle.init(key),
-        opt_state=(),
-        round_idx=jnp.zeros((), jnp.int32),
-        key=key,
+        variables=bundle.init(key), opt_state=(),
+        round_idx=jnp.zeros((), jnp.int32), key=key,
+    )
+    return round_fn, state, args, C * S * B * epochs * rounds_per_call
+
+
+def main():
+    p = argparse.ArgumentParser()
+    # 10 clients all participating = the reference's cross-silo ResNet-56
+    # benchmark cohort (BASELINE.md: "10 clients all participating,
+    # E=20, batch 64")
+    p.add_argument("--clients", type=int, default=10)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--steps", type=int, default=24)
+    p.add_argument("--epochs", type=int, default=1)
+    p.add_argument("--rounds", type=int, default=4,
+                   help="measured multi-round calls (median over these)")
+    p.add_argument(
+        "--rounds-per-call", type=int, default=80,
+        help="federated rounds fused per compiled call "
+        "(make_multi_round_fn); 1 = per-round dispatch path. Measured "
+        "ladder on v5e (PROFILE.md): 10=26.5k, 20=27.6k, 40=28.4k, "
+        "80=28.8k samples/s. 80 is the default (~43 s/call — still "
+        "under the axon tunnel's ~70 s single-execution deadline; on "
+        "direct-attached chips any value works)",
+    )
+    p.add_argument(
+        "--unroll", type=int, default=4,
+        help="step-scan unroll inside the local update (TPU while-loop "
+        "bookkeeping is ~0.3ms/iteration; 4 measured best on v5e)",
+    )
+    p.add_argument(
+        "--client-unroll", type=int, default=1,
+        help="unroll of the sequential client loop (1 = lax.map); trades "
+        "compiled-code size for fewer while-loop iterations",
+    )
+    p.add_argument(
+        "--dtype",
+        default="bf16",
+        help="compute dtype for the local-training forward/backward. "
+        "bf16 = mixed precision (fp32 masters/optimizer/aggregation): "
+        "~1.5-2x fp32 on the MXU; convergence parity with fp32 is "
+        "unit-tested (tests/test_fedavg.py::test_fedavg_mixed_precision_bf16).",
+    )
+    args = p.parse_args()
+
+    import jax
+
+    # persistent compile cache: the driver runs this in a fresh process,
+    # so without it the measured session pays the full ~50s compile and
+    # any warmup-budget interaction with it
+    jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
+
+    round_fn, state, call_args, samples_per_call = build_north_star(
+        clients=args.clients, batch=args.batch, steps=args.steps,
+        epochs=args.epochs, dtype=args.dtype, unroll=args.unroll,
+        rounds_per_call=args.rounds_per_call,
+        client_unroll=args.client_unroll,
     )
 
     # shared methodology (fedml_tpu/utils/timing.py): warm until two
@@ -123,13 +151,7 @@ def main():
     # times with the scalar readback INSIDE the timed window
     from fedml_tpu.utils.timing import measure_rounds
 
-    med, state = measure_rounds(
-        round_fn,
-        state,
-        (x, y, mask, num_samples, participation, slot_ids),
-        args.rounds,
-    )
-    samples_per_call = C * S * B * args.epochs * args.rounds_per_call
+    med, state = measure_rounds(round_fn, state, call_args, args.rounds)
     sps = samples_per_call / med
     print(
         json.dumps(

@@ -88,6 +88,7 @@ class FedAvgServerManager(NodeManager):
         self.pending: Dict[int, dict] = {}
         self.round_log = []
         self.round_timeout = round_timeout
+        self.zero_participant_rounds = 0
         # _on_model runs on the backend reader thread, the deadline on a
         # Timer thread: one lock serializes round completion, and the
         # timer is generation-checked so a stale deadline (its round
@@ -199,6 +200,20 @@ class FedAvgServerManager(NodeManager):
         dropped = sorted(sampled - set(self.pending))
         if dropped:
             rec["dropped"] = dropped  # deadline expired without them
+        if not self.pending:
+            # a zero-participant round is a silent no-op update; a run
+            # where EVERY round is one (deadline shorter than client
+            # train time — all uploads arrive a round late and are
+            # stale-rejected) would otherwise "finish" with the init
+            # model and rc=0.  Count them so callers can fail loudly.
+            import logging
+
+            self.zero_participant_rounds += 1
+            logging.warning(
+                "round %d closed with ZERO participants (deadline %.1fs; "
+                "sampled %s) — global model unchanged this round",
+                self.round_idx, self.round_timeout or -1.0, sorted(sampled),
+            )
         self.round_log.append(rec)
         self.pending.clear()
         self.round_idx += 1

@@ -216,9 +216,18 @@ def make_local_update(
             )
             return (variables, opt_state), auxs
 
-        (variables, _), auxs = jax.lax.scan(
-            epoch_body, (variables, opt_state), jnp.arange(epochs)
-        )
+        if epochs == 1:
+            # elide the outer while loop entirely: the TPU scalar-core
+            # bookkeeping for a length-1 scan is pure overhead (the
+            # PROFILE.md `while` share), and E=1 is the reference's
+            # default benchmark regime.  fold_in(rng, 0) keeps the RNG
+            # stream identical to the scan path.
+            (variables, _), auxs0 = epoch_body((variables, opt_state), 0)
+            auxs = jax.tree_util.tree_map(lambda a: a[None], auxs0)
+        else:
+            (variables, _), auxs = jax.lax.scan(
+                epoch_body, (variables, opt_state), jnp.arange(epochs)
+            )
         metrics = {
             "loss_sum": auxs["loss_sum"][-1].sum(),
             "correct": auxs["correct"][-1].sum(),

@@ -131,7 +131,18 @@ def run_server(args) -> None:
             rounds=server.round_idx,
             round_log=json.dumps(server.round_log),
         )
-    print(json.dumps({"rounds": server.round_idx}), flush=True)
+    print(json.dumps({
+        "rounds": server.round_idx,
+        "zero_participant_rounds": server.zero_participant_rounds,
+    }), flush=True)
+    if server.zero_participant_rounds >= server.comm_rounds:
+        # every round aggregated nobody (deadline shorter than client
+        # train time): the "final" model is the init model — fail loudly
+        # instead of handing back rc=0
+        print("ERROR: all rounds closed with zero participants; the "
+              "model was never updated (round_timeout too short?)",
+              file=sys.stderr, flush=True)
+        sys.exit(2)
 
 
 def run_client(args) -> None:

@@ -225,6 +225,7 @@ def test_server_deadline_zero_arrivals_and_stale_reply():
     assert server.round_idx >= 1
     rec = server.round_log[0]
     assert rec["participants"] == [] and rec["dropped"] == [1, 2]
+    assert server.zero_participant_rounds >= 1  # counted for loud failure
     np.testing.assert_array_equal(
         np.asarray(server.variables["params"]["w"]), np.ones((2, 2))
     )

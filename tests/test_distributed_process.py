@@ -65,9 +65,15 @@ def test_sampled_client_death_deadline_matches_masked_simulation(tmp_path):
     env["FEDML_TPU_FORCE_CPU"] = "1"
     env["JAX_PLATFORMS"] = "cpu"
     env["XLA_FLAGS"] = ""
+    # round_timeout bounds each round (the dead client never uploads, so
+    # every round closes BY deadline): large enough that the two live
+    # clients always make it even on the loaded 1-core CI box (a 3 s
+    # deadline flaked under full-suite contention — jax import + first
+    # compile in the client processes can exceed it), small enough the
+    # test stays ~1 min
     rc = launch(
         num_clients=3, rounds=2, seed=0, batch_size=16, out_path=out,
-        round_timeout=3.0, slow_client_delay=60.0,
+        round_timeout=20.0, slow_client_delay=120.0,
         kill_slow_client_after=1.0, env=env,
     )
     assert rc == 0, "server subprocess failed"

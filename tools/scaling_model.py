@@ -67,48 +67,34 @@ def payload_bytes():
 
 
 def measure_t_compute():
-    """bench.py's exact workload + timing protocol, returning s/round."""
+    """bench.py's exact workload + timing protocol, returning s/round.
+    The workload is IMPORTED from bench.py (build_north_star) so the two
+    can never diverge — same model, dtype, unroll, rounds_per_call."""
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     jax.config.update("jax_compilation_cache_dir", "/tmp/jax_bench_cache")
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 2.0)
 
-    from fedml_tpu.algorithms.fedavg import (ServerState, make_multi_round_fn,
-                                             resolve_compute_dtype)
-    from fedml_tpu.core.client import make_client_optimizer, make_local_update
-    from fedml_tpu.models.resnet import resnet56
+    from bench import build_north_star
     from fedml_tpu.utils.timing import measure_rounds
 
-    bundle = resnet56(num_classes=10)
-    lu = make_local_update(
-        bundle, make_client_optimizer("sgd", 0.001, momentum=0.9,
-                                      weight_decay=0.001),
-        epochs=1, compute_dtype=resolve_compute_dtype("bf16"), unroll=4,
+    rpc = 80  # bench.py default
+    round_fn, state, call_args, samples = build_north_star(
+        rounds_per_call=rpc
     )
-    rpc = 40
-    round_fn = jax.jit(make_multi_round_fn(lu, rpc))
-    rng = np.random.RandomState(0)
-    C, S, B = 10, 24, 64
-    args_ = (
-        jnp.asarray(rng.rand(C, S, B, 32, 32, 3).astype(np.float32)),
-        jnp.asarray(rng.randint(0, 10, (C, S, B)).astype(np.int32)),
-        jnp.ones((C, S, B), jnp.float32),
-        jnp.full((C,), S * B, jnp.float32),
-        jnp.ones((C,), jnp.float32),
-        jnp.arange(C, dtype=jnp.int32),
-    )
-    key = jax.random.PRNGKey(0)
-    state = ServerState(variables=bundle.init(key), opt_state=(),
-                        round_idx=jnp.zeros((), jnp.int32), key=key)
-    med, _ = measure_rounds(round_fn, state, args_, 3)
+    med, _ = measure_rounds(round_fn, state, call_args, 3)
     return med / rpc
 
 
 def model_efficiency(t_compute: float, v_bytes: int, n: int,
                      bw: float = V5E_ICI_BW) -> dict:
-    t_ar = 2.0 * v_bytes * (n - 1) / n / bw + (n / 2) * HOP_LATENCY
+    # bandwidth term: reduce-scatter + all-gather move 2V(N-1)/N bytes
+    # through each link.  Latency term: a ring all-reduce is 2(N-1)
+    # SEQUENTIAL steps, each paying hop latency — not N/2 (an earlier
+    # draft used the ring DIAMETER, which understates latency ~4x and
+    # would contradict the "conservative" framing).
+    t_ar = (2.0 * v_bytes * (n - 1) / n / bw
+            + 2.0 * (n - 1) * HOP_LATENCY)
     return {
         "chips": n,
         "t_allreduce_ms": round(t_ar * 1e3, 4),
@@ -158,7 +144,7 @@ def main():
                               "2D torus — conservative by up to 4x",
                 "hop_latency_s": HOP_LATENCY,
             },
-            "formula": "eff(N) = t_c / (t_c + 2V(N-1)/(N*BW) + N/2*lat)",
+            "formula": "eff(N) = t_c / (t_c + 2V(N-1)/(N*BW) + 2(N-1)*lat)",
             "points": chips,
             "dcn_point": dcn,
             "headline": {

@@ -141,23 +141,52 @@ class TcpHub:
 
 
 class TcpBackend(CommBackend):
-    def __init__(self, node_id: int, host: str, port: int, timeout: float = 30.0):
+    """One node's hub connection.
+
+    ``auto_reconnect`` (attempts; 0 = off) makes ``run()`` survive a
+    dropped hub connection: on EOF/reset the backend dials again,
+    re-registers (the hub's identity guard swaps the live conn,
+    ``TcpHub._serve_conn``), and resumes the read loop.  Frames routed
+    while disconnected are lost — by design the round-deadline server
+    (``fedavg_cross_device``) treats the node as a straggler for that
+    round and it rejoins at the next sync.
+    """
+
+    def __init__(self, node_id: int, host: str, port: int,
+                 timeout: float = 30.0, auto_reconnect: int = 0):
         super().__init__(node_id)
-        self._sock = socket.create_connection((host, port), timeout=timeout)
-        self._sock.sendall((json.dumps({"node_id": node_id}) + "\n").encode())
-        self._file = self._sock.makefile("rb")
-        # wait for the hub's registration ACK: afterwards, any frame sent
-        # TO this node can be delivered — no startup race
-        ack = self._file.readline()
-        if not ack or json.loads(ack).get("__hub__") != "ack":
-            raise ConnectionError(f"node {node_id}: no hub ACK")
-        self._sock.settimeout(None)
+        self._host, self._port, self._timeout = host, port, timeout
+        self.auto_reconnect = auto_reconnect
         self._stopped = threading.Event()
+        # serializes send_message against _dial's socket swap: without
+        # it, a send between "socket connected" and "hello written"
+        # lands BEFORE the registration line and the hub parses the
+        # message frame as the hello (KeyError, conn dropped, frame lost)
+        self._send_lock = threading.Lock()
+        self._dial()
+
+    def _dial(self):
+        with self._send_lock:
+            sock = socket.create_connection(
+                (self._host, self._port), timeout=self._timeout
+            )
+            sock.sendall(
+                (json.dumps({"node_id": self.node_id}) + "\n").encode()
+            )
+            f = sock.makefile("rb")
+            # wait for the hub's registration ACK: afterwards, any frame
+            # sent TO this node can be delivered — no startup race
+            ack = f.readline()
+            if not ack or json.loads(ack).get("__hub__") != "ack":
+                raise ConnectionError(f"node {self.node_id}: no hub ACK")
+            sock.settimeout(None)
+            self._sock, self._file = sock, f
 
     def send_message(self, msg: Message) -> None:
         # to_json() is already one valid JSON line (newlines escape inside
         # JSON strings) — no re-parse needed
-        self._sock.sendall((msg.to_json() + "\n").encode())
+        with self._send_lock:
+            self._sock.sendall((msg.to_json() + "\n").encode())
 
     def await_peers(self, ids, timeout: float = 60.0) -> None:
         """Block until every node id in ``ids`` is registered at the hub.
@@ -243,10 +272,31 @@ class TcpBackend(CommBackend):
                 pass
 
     def run(self) -> None:
+        retries = self.auto_reconnect
         while not self._stopped.is_set():
-            line = self._file.readline()
+            try:
+                line = self._file.readline()
+            except OSError:
+                line = b""
             if not line:
-                return
+                if self._stopped.is_set() or retries <= 0:
+                    return
+                retries -= 1
+                import time as _time
+
+                _time.sleep(0.2)
+                try:
+                    self._dial()  # re-register; hub swaps the live conn
+                    logging.warning(
+                        "node %d: hub connection lost — reconnected "
+                        "(%d retries left)", self.node_id, retries,
+                    )
+                    continue
+                except (OSError, ConnectionError):
+                    logging.exception(
+                        "node %d: reconnect failed", self.node_id
+                    )
+                    continue  # retry until the budget runs out
             try:
                 frame = json.loads(line)
             except json.JSONDecodeError:

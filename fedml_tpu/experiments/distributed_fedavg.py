@@ -62,13 +62,15 @@ def _build_problem(seed: int, num_clients: int):
     return ds, bundle, init, lu
 
 
-def _connect_backend(node_id: int, host: str, port: int, retries: int = 50):
+def _connect_backend(node_id: int, host: str, port: int, retries: int = 50,
+                     auto_reconnect: int = 0):
     """The hub may still be binding when a worker starts: retry."""
     from fedml_tpu.comm.tcp import TcpBackend
 
     for attempt in range(retries):
         try:
-            return TcpBackend(node_id, host, port)
+            return TcpBackend(node_id, host, port,
+                              auto_reconnect=auto_reconnect)
         except (ConnectionError, OSError):
             if attempt == retries - 1:
                 raise
@@ -150,7 +152,11 @@ def run_client(args) -> None:
     from fedml_tpu.algorithms.fedavg_cross_device import FedAvgClientManager
 
     ds, bundle, init, lu = _build_problem(args.seed, args.num_clients)
-    backend = _connect_backend(args.node_id, args.host, args.port)
+    # clients ride out transient hub-connection drops: re-dial +
+    # re-register, rejoining as a straggler for the missed round (the
+    # server's round deadline covers the gap)
+    backend = _connect_backend(args.node_id, args.host, args.port,
+                               auto_reconnect=3)
     FedAvgClientManager(
         backend, lu, ds, batch_size=args.batch_size,
         template_variables=init, seed=args.seed,

@@ -62,3 +62,27 @@ def test_clients_mode_points(capsys):
         assert r["metric"] == "clients_per_chip_throughput"
         assert r["value"] > 0
         assert r["rounds_per_call"] == 2
+
+
+def test_convergence_median_round_seconds():
+    """Burst-aware steady-state median (tools/convergence_run.py):
+    chunked run_fused logging must not collapse the median to ~0, and
+    the compile-laden first burst is excluded."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir, "tools"))
+    from convergence_run import median_round_seconds
+
+    # rpc=1: [0, compile+r0, then 35s rounds with one 600s stall]
+    stamps = [0.0, 147.0, 182.5, 218.0, 253.5, 853.5, 889.0]
+    assert abs(median_round_seconds(stamps) - 35.5) < 0.01
+
+    # rpc=3: rows logged in bursts of 3 (same stamp); 3 rounds per 105s
+    t, stamps = 0.0, [0.0]
+    stamps += [150.0] * 3            # compile + first chunk (excluded)
+    for chunk in range(4):
+        t = 150.0 + (chunk + 1) * 105.0
+        stamps += [t] * 3
+    med = median_round_seconds(stamps)
+    assert abs(med - 35.0) < 0.01, med
+
+    assert median_round_seconds([0.0]) is None

@@ -107,9 +107,10 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--measure", action="store_true",
                    help="re-time the workload on the local real chip")
-    p.add_argument("--t-compute", type=float, default=0.5407,
-                   help="s/round on one chip (bench r3: 28,404 samples/s "
-                   "over 15,360 samples/round)")
+    p.add_argument("--t-compute", type=float, default=0.5330,
+                   help="s/round on one chip (bench r3 measured ladder, "
+                   "rpc=80 default: 28,818 samples/s over 15,360 "
+                   "samples/round — PROFILE.md r3 table)")
     p.add_argument("--out", default="SCALING_r03.json")
     p.add_argument("--merge", default="SCALING_r02.json",
                    help="carry over the measured clients-per-chip ladder")

@@ -65,10 +65,18 @@ class TcpHub:
             if not hello:
                 return
             node_id = json.loads(hello)["node_id"]
+            # ACK BEFORE registering: once registered, _forward from
+            # other reader threads may write to this conn concurrently,
+            # and an ACK interleaved with a routed frame would hand the
+            # dialing client garbage as its handshake line.  A frame
+            # routed in the ack→register window is dropped — but nobody
+            # can have observed this node as registered yet (await_peers
+            # reads the registry), so that is the normal unregistered-
+            # receiver drop, not a race.
+            conn.sendall((json.dumps(_ACK) + "\n").encode())
             with self._lock:
                 self._conns[node_id] = conn
                 self._send_locks[node_id] = threading.Lock()
-            conn.sendall((json.dumps(_ACK) + "\n").encode())
             while True:
                 line = f.readline()
                 if not line:
@@ -170,16 +178,37 @@ class TcpBackend(CommBackend):
             sock = socket.create_connection(
                 (self._host, self._port), timeout=self._timeout
             )
-            sock.sendall(
-                (json.dumps({"node_id": self.node_id}) + "\n").encode()
-            )
-            f = sock.makefile("rb")
-            # wait for the hub's registration ACK: afterwards, any frame
-            # sent TO this node can be delivered — no startup race
-            ack = f.readline()
-            if not ack or json.loads(ack).get("__hub__") != "ack":
-                raise ConnectionError(f"node {self.node_id}: no hub ACK")
+            try:
+                sock.sendall(
+                    (json.dumps({"node_id": self.node_id}) + "\n").encode()
+                )
+                f = sock.makefile("rb")
+                # wait for the hub's registration ACK — guaranteed to be
+                # the FIRST line on the conn (the hub ACKs before
+                # registering, so no routed frame can precede or
+                # interleave it); afterwards, any frame sent TO this
+                # node can be delivered
+                ack = f.readline()
+                if not ack or json.loads(ack).get("__hub__") != "ack":
+                    raise ConnectionError(
+                        f"node {self.node_id}: no hub ACK"
+                    )
+            except BaseException:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
             sock.settimeout(None)
+            # close the connection being replaced (reconnect path) —
+            # without this every reconnect cycle leaks an fd
+            for stale in (getattr(self, "_file", None),
+                          getattr(self, "_sock", None)):
+                if stale is not None:
+                    try:
+                        stale.close()
+                    except OSError:
+                        pass
             self._sock, self._file = sock, f
 
     def send_message(self, msg: Message) -> None:

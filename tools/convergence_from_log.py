@@ -21,7 +21,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from convergence_run import (median_round_seconds,  # noqa: E402
-                             rounds_to_target)
+                             northstar_metadata, rounds_to_target)
 
 
 def parse_log(path):
@@ -39,10 +39,33 @@ def parse_log(path):
     return runs
 
 
+def pick_runs(per_log):
+    """One row-list per tag across logs.  Same-tag rows from DIFFERENT
+    logs are never concatenated (each log's elapsed_s restarts at 0, so
+    a blind merge corrupts wall-clock, the steady-state median, and
+    mixes stale partial rounds with rerun rounds) — the log with the
+    most completed rounds wins, with a stderr note."""
+    chosen = {}
+    for log, runs in per_log:
+        for tag, rows in runs.items():
+            if tag in chosen and len(chosen[tag][1]) >= len(rows):
+                print(f"note: {tag} also in {log} ({len(rows)} rows) — "
+                      f"keeping {chosen[tag][0]} "
+                      f"({len(chosen[tag][1])} rows)", file=sys.stderr)
+                continue
+            if tag in chosen:
+                print(f"note: {tag} in {chosen[tag][0]} superseded by "
+                      f"{log} ({len(rows)} rows)", file=sys.stderr)
+            chosen[tag] = (log, rows)
+    return {tag: rows for tag, (log, rows) in chosen.items()}
+
+
 def summarize(rows, target):
     evals = [r for r in rows if "test_acc" in r]
     stamps = [0.0] + [r["elapsed_s"] for r in rows]
     med = median_round_seconds(stamps)
+    from convergence_run import trajectory_rows
+
     return {
         "rounds_completed": rows[-1]["round"] + 1 if rows else 0,
         "final_test_acc": evals[-1]["test_acc"] if evals else None,
@@ -51,45 +74,49 @@ def summarize(rows, target):
         "steady_state_s_per_round_median": (
             round(med, 2) if med is not None else None
         ),
-        "trajectory": [
-            {"round": r["round"], "test_acc": r["test_acc"],
-             "test_loss": r["test_loss"],
-             **({"train_acc": r["train_acc"]} if "train_acc" in r else {})}
-            for r in evals
-        ],
+        "trajectory": trajectory_rows(rows),
     }
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("log")
+    p.add_argument("logs", nargs="+",
+                   help="one or more convergence_run logs; their [tag] "
+                   "rows are merged (e.g. an iid log + a noniid rerun "
+                   "after a tunnel wedge)")
     p.add_argument("--out", default="CONVERGENCE_r03.json")
     p.add_argument("--label-noise", type=float, default=0.1)
+    p.add_argument("--noise", type=float, default=1.2)
+    # config-fidelity flags: the reconstructed artifact's config section
+    # must describe the run the LOG came from, not the tool defaults
+    p.add_argument("--rounds", type=int, default=100)
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--num-train", type=int, default=50000)
+    p.add_argument("--num-test", type=int, default=10000)
+    p.add_argument("--platform", default="tpu")
     args = p.parse_args()
 
     ceiling = 1.0 - args.label_noise
     target = 0.9 * ceiling
-    runs = {tag: summarize(rows, target)
-            for tag, rows in parse_log(args.log).items()}
+    merged = pick_runs([(log, parse_log(log)) for log in args.logs])
+    runs = {tag: summarize(rows, target) for tag, rows in merged.items()}
     out = {
-        "provenance": f"reconstructed from the streamed run log "
-                      f"({os.path.basename(args.log)}) by "
-                      "tools/convergence_from_log.py",
-        "hardness": {"label_noise_eta": args.label_noise,
-                     "accuracy_ceiling": ceiling,
-                     "target_for_rounds_to_target": round(target, 4)},
+        **northstar_metadata(noise=args.noise,
+                             label_noise=args.label_noise,
+                             epochs=args.epochs, rounds=args.rounds,
+                             num_train=args.num_train,
+                             num_test=args.num_test),
+        "provenance": "reconstructed from the streamed run logs "
+                      f"({', '.join(os.path.basename(l) for l in args.logs)}) "
+                      "by tools/convergence_from_log.py",
+        "platform": args.platform,
         "runs": runs,
     }
     if {"iid", "noniid_lda0.5"} <= set(runs):
-        a, b = runs["iid"], runs["noniid_lda0.5"]
-        out["comparison"] = {
-            "final_acc_gap_iid_minus_noniid": round(
-                (a["final_test_acc"] or 0) - (b["final_test_acc"] or 0), 5),
-            "ordering_matches_reference": (
-                (a["final_test_acc"] or 0) >= (b["final_test_acc"] or 0)),
-            "rounds_to_target": {"iid": a["rounds_to_target"],
-                                 "noniid": b["rounds_to_target"]},
-        }
+        from convergence_run import build_comparison
+        out["comparison"] = build_comparison(
+            runs, {t: r["trajectory"] for t, r in runs.items()}
+        )
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({t: {"final": r["final_test_acc"],

@@ -63,6 +63,31 @@ def rounds_to_target(hist, target):
     return None
 
 
+def build_comparison(runs, hists):
+    """IID vs non-IID comparison: final-acc gap, ordering, and
+    rounds-to-target at a RELATIVE target both runs reach (95% of the
+    worse run's final) — the absolute ceiling-derived target can be
+    unreached by both when the generalization gap, not the label noise,
+    binds (observed at sigma=1.2)."""
+    a, b = runs["iid"], runs["noniid_lda0.5"]
+    rel = 0.95 * min(a["final_test_acc"] or 0, b["final_test_acc"] or 0)
+    return {
+        "final_acc_gap_iid_minus_noniid": round(
+            (a["final_test_acc"] or 0) - (b["final_test_acc"] or 0), 5),
+        "ordering_matches_reference": (
+            (a["final_test_acc"] or 0) >= (b["final_test_acc"] or 0)),
+        "rounds_to_target": {
+            "iid": a["rounds_to_target"],
+            "noniid": b["rounds_to_target"],
+        },
+        "relative_target": round(rel, 4),
+        "rounds_to_relative_target": {
+            "iid": rounds_to_target(hists["iid"], rel),
+            "noniid": rounds_to_target(hists["noniid_lda0.5"], rel),
+        },
+    }
+
+
 def median_round_seconds(stamps, burst_gap: float = 0.2):
     """Steady-state per-round seconds from log timestamps.
 
@@ -83,6 +108,44 @@ def median_round_seconds(stamps, burst_gap: float = 0.2):
         (b[0] - a[0]) / b[1] for a, b in zip(bursts, bursts[1:])
     )
     return per_round[len(per_round) // 2] if per_round else None
+
+
+def northstar_metadata(*, noise=1.2, label_noise=0.1, epochs=20,
+                       rounds=100, num_train=50000, num_test=10000):
+    """The artifact's standard header sections (shared with
+    tools/convergence_from_log.py so a log-reconstructed artifact has
+    the same schema as a tool-written one)."""
+    ceiling = 1.0 - label_noise
+    return {
+        "experiment": "north-star convergence, IID vs non-IID pair "
+                      "(synthetic CIFAR-10 stand-in, fused driver)",
+        "reference_target": {
+            "dataset": "CIFAR-10 (real, unavailable offline: zero egress)",
+            "iid_acc": 93.19,
+            "non_iid_acc": 87.12,
+            "rounds": 100,
+            "source": "/root/reference/benchmark/README.md:105",
+            "claim_reproduced": "ordering (IID >= non-IID at fixed "
+                                "rounds) + rounds-to-target worsening "
+                                "under LDA, on a task with a documented "
+                                "accuracy ceiling",
+        },
+        "hardness": {
+            "feature_noise_sigma": noise,
+            "label_noise_eta": label_noise,
+            "accuracy_ceiling": ceiling,
+            "target_for_rounds_to_target": round(0.9 * ceiling, 4),
+        },
+        "config": {
+            "model": "resnet56", "clients": 10, "clients_per_round": 10,
+            "optimizer": "sgd", "lr": 1e-3, "weight_decay": 1e-3,
+            "local_epochs": epochs, "batch_size": 64,
+            "rounds": rounds, "compute_dtype": "bf16",
+            "train_samples": num_train, "test_samples": num_test,
+            "driver": "FedAvgSimulation.run_fused (make_multi_round_fn "
+                      "between evals)",
+        },
+    }
 
 
 def write_artifact(out, artifact, summary):
@@ -227,51 +290,15 @@ def main():
         write_artifact(args.out + ".partial", {"runs": dict(runs)},
                        {"partial_after": tag})
 
-    artifact = {
-        "experiment": "north-star convergence, IID vs non-IID pair "
-                      "(synthetic CIFAR-10 stand-in, fused driver)",
-        "reference_target": {
-            "dataset": "CIFAR-10 (real, unavailable offline: zero egress)",
-            "iid_acc": 93.19,
-            "non_iid_acc": 87.12,
-            "rounds": 100,
-            "source": "/root/reference/benchmark/README.md:105",
-            "claim_reproduced": "ordering (IID >= non-IID at fixed "
-                                "rounds) + rounds-to-target worsening "
-                                "under LDA, on a task with a documented "
-                                "accuracy ceiling",
-        },
-        "hardness": {
-            "feature_noise_sigma": args.noise,
-            "label_noise_eta": args.label_noise,
-            "accuracy_ceiling": ceiling,
-            "target_for_rounds_to_target": round(target, 4),
-        },
-        "config": {
-            "model": "resnet56", "clients": 10, "clients_per_round": 10,
-            "optimizer": "sgd", "lr": 1e-3, "weight_decay": 1e-3,
-            "local_epochs": args.epochs, "batch_size": 64,
-            "rounds": args.rounds, "compute_dtype": "bf16",
-            "train_samples": args.num_train, "test_samples": args.num_test,
-            "driver": "FedAvgSimulation.run_fused (make_multi_round_fn "
-                      "between evals)",
-        },
-        "runs": runs,
-    }
+    artifact = {**northstar_metadata(
+        noise=args.noise, label_noise=args.label_noise,
+        epochs=args.epochs, rounds=args.rounds,
+        num_train=args.num_train, num_test=args.num_test,
+    ), "runs": runs}
     if {"iid", "noniid_lda0.5"} <= set(runs):
-        a, b = runs["iid"], runs["noniid_lda0.5"]
-        artifact["comparison"] = {
-            "final_acc_gap_iid_minus_noniid": round(
-                (a["final_test_acc"] or 0) - (b["final_test_acc"] or 0), 5
-            ),
-            "ordering_matches_reference": (
-                (a["final_test_acc"] or 0) >= (b["final_test_acc"] or 0)
-            ),
-            "rounds_to_target": {
-                "iid": a["rounds_to_target"],
-                "noniid": b["rounds_to_target"],
-            },
-        }
+        artifact["comparison"] = build_comparison(
+            runs, {t: r["trajectory"] for t, r in runs.items()}
+        )
     write_artifact(args.out, artifact, {
         t: {"final": r["final_test_acc"], "rtt": r["rounds_to_target"],
             "s_per_round": r["wall_clock_per_round_s"]}

@@ -321,7 +321,10 @@ class TcpBackend(CommBackend):
                         "(%d retries left)", self.node_id, retries,
                     )
                     continue
-                except (OSError, ConnectionError):
+                except (OSError, ConnectionError, json.JSONDecodeError):
+                    # JSONDecodeError: a hub that died mid-ACK leaves a
+                    # partial line — that's a failed dial, not a reason
+                    # to kill the reader thread with retries remaining
                     logging.exception(
                         "node %d: reconnect failed", self.node_id
                     )

@@ -20,8 +20,9 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from convergence_run import (median_round_seconds,  # noqa: E402
-                             northstar_metadata, rounds_to_target)
+from convergence_run import (build_comparison,  # noqa: E402
+                             median_round_seconds, northstar_metadata,
+                             rounds_to_target, trajectory_rows)
 
 
 def parse_log(path):
@@ -64,8 +65,6 @@ def summarize(rows, target):
     evals = [r for r in rows if "test_acc" in r]
     stamps = [0.0] + [r["elapsed_s"] for r in rows]
     med = median_round_seconds(stamps)
-    from convergence_run import trajectory_rows
-
     return {
         "rounds_completed": rows[-1]["round"] + 1 if rows else 0,
         "final_test_acc": evals[-1]["test_acc"] if evals else None,
@@ -113,7 +112,6 @@ def main():
         "runs": runs,
     }
     if {"iid", "noniid_lda0.5"} <= set(runs):
-        from convergence_run import build_comparison
         out["comparison"] = build_comparison(
             runs, {t: r["trajectory"] for t, r in runs.items()}
         )

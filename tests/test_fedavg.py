@@ -422,3 +422,47 @@ def test_synthetic_label_noise_ceiling():
     assert 0.75 < acc < 0.85  # ceiling ≈ 1 - η = 0.8
     flipped = float((clean_pred != ds.test_y).mean())
     assert 0.15 < flipped < 0.25
+
+
+def test_run_fused_checkpoint_resume(tmp_path):
+    """Checkpoint mid-run, rebuild the simulation fresh, restore, and
+    continue with run_fused: the final state must be bit-identical to an
+    uninterrupted run (the convergence driver's tunnel-wedge recovery
+    path — tools/convergence_run.py --checkpoint-dir)."""
+    import numpy as np
+
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig, FedAvgSimulation
+    from fedml_tpu.core.checkpoint import CheckpointManager
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models.linear import logistic_regression
+
+    ds = synthetic_classification(
+        num_train=120, num_test=40, input_shape=(10,), num_classes=3,
+        num_clients=4, partition="hetero", seed=9,
+    )
+    cfg = FedAvgConfig(num_clients=4, clients_per_round=4, comm_rounds=6,
+                       epochs=1, batch_size=8, lr=0.2, seed=9,
+                       frequency_of_the_test=2)
+    bundle = logistic_regression(10, 3)
+
+    ref = FedAvgSimulation(bundle, ds, cfg)
+    ref.run_fused()
+
+    a = FedAvgSimulation(bundle, ds, cfg)
+    a.run_fused(rounds=3)  # interrupted after round 2
+    mgr = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+    mgr.save(3, a.state)
+
+    b = FedAvgSimulation(bundle, ds, cfg)  # fresh process analogue
+    b.state = mgr.restore(like=b.state)
+    assert int(b.state.round_idx) == 3
+    b.run_fused(rounds=cfg.comm_rounds - 3)
+
+    for la, lb in zip(jax.tree_util.tree_leaves(ref.state.variables),
+                      jax.tree_util.tree_leaves(b.state.variables)):
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb))
+    # resumed eval cadence keys on ABSOLUTE rounds: same eval rounds as
+    # the uninterrupted run's tail
+    ref_evals = [h["round"] for h in ref.history if "test_acc" in h]
+    b_evals = [h["round"] for h in b.history if "test_acc" in h]
+    assert [r for r in ref_evals if r >= 3] == b_evals

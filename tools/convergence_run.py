@@ -169,6 +169,7 @@ def run_northstar_once(partition, args, log_prefix):
     import jax
 
     from fedml_tpu.algorithms.fedavg import FedAvgConfig, FedAvgSimulation
+    from fedml_tpu.core.checkpoint import CheckpointManager
     from fedml_tpu.data.synthetic import synthetic_classification
     from fedml_tpu.models.resnet import resnet56
 
@@ -199,6 +200,50 @@ def run_northstar_once(partition, args, log_prefix):
         name=f"cifar10-standin-{partition}",
     )
     sim = FedAvgSimulation(resnet56(num_classes=10), ds, cfg)
+
+    # resume support: the axon tunnel wedges/crashes mid-session (a 2.7 h
+    # two-run session died at noniid round 44 this round) — checkpoint
+    # the full ServerState at every eval chunk and continue from the
+    # latest on restart.  run_fused keys its eval cadence on the ABSOLUTE
+    # state.round_idx, so a resumed run evaluates on the same rounds.
+    mgr = None
+    start_round = 0
+    if getattr(args, "checkpoint_dir", ""):
+        tag = "iid" if partition == "homo" else "noniid"
+        ckdir = os.path.join(args.checkpoint_dir, tag)
+        # config stamp: a checkpoint from a DIFFERENT experiment (other
+        # noise/seed/epochs — same pytree shapes, so the shape guard
+        # can't catch it) must never be silently resumed into this run
+        stamp = {"noise": args.noise, "label_noise": args.label_noise,
+                 "epochs": args.epochs, "rounds": args.rounds,
+                 "num_train": args.num_train, "seed": 0}
+        stamp_path = os.path.join(ckdir, "config_stamp.json")
+        os.makedirs(ckdir, exist_ok=True)
+        if os.path.exists(stamp_path):
+            prior = json.load(open(stamp_path))
+            if prior != stamp:
+                raise SystemExit(
+                    f"checkpoint dir {ckdir} holds a run with a "
+                    f"different config ({prior} != {stamp}); pass "
+                    "--checkpoint-dir '' or remove the directory"
+                )
+        else:
+            with open(stamp_path, "w") as f:
+                json.dump(stamp, f)
+        mgr = CheckpointManager(ckdir, max_to_keep=2)
+        if mgr.latest_step() is not None:
+            sim.state = mgr.restore(like=sim.state)
+            start_round = int(sim.state.round_idx)
+            if start_round >= args.rounds:
+                raise SystemExit(
+                    f"checkpoint at round {start_round} >= --rounds "
+                    f"{args.rounds}: this run already completed — "
+                    "remove the checkpoint dir to start fresh (a "
+                    "0-round 'run' would write a degenerate artifact)"
+                )
+            print(f"{log_prefix} resumed from checkpoint at round "
+                  f"{start_round}", flush=True)
+
     t0 = time.time()
     stamps = [0.0]
 
@@ -208,19 +253,17 @@ def run_northstar_once(partition, args, log_prefix):
         line["elapsed_s"] = round(time.time() - t0, 1)
         stamps.append(time.time() - t0)
         print(f"{log_prefix} {json.dumps(line)}", flush=True)
+        if mgr is not None and "test_acc" in m:
+            mgr.save(m["round"] + 1, sim.state)
 
-    hist = sim.run_fused(log_fn=log_fn,
+    hist = sim.run_fused(rounds=args.rounds - start_round, log_fn=log_fn,
                          rounds_per_call=args.rounds_per_call or None)
     wall = time.time() - t0
-    # median per-round delta = the framework's steady-state number; the
-    # MEAN additionally carries compile time and the axon tunnel's
-    # intermittent multi-minute stalls (observed: 35.4 s/round steady
-    # with rare 250-900 s hiccups), which are environment, not framework.
-    # run_fused logs a fused chunk's rows in one burst, so group rows by
-    # burst (deltas < 0.2 s are same-chunk) and normalize each burst's
-    # wall delta by its row count — a raw per-row median would collapse
-    # to ~0 whenever rounds_per_call > 1.
-    return hist, wall, median_round_seconds(stamps), cfg
+    # median per-round wall = the framework's steady-state number (see
+    # median_round_seconds: burst-aware, first/compile burst excluded);
+    # the MEAN additionally carries the tunnel's 250-900 s stalls, which
+    # are environment, not framework
+    return hist, wall, median_round_seconds(stamps), cfg, start_round
 
 
 def main():
@@ -250,6 +293,10 @@ def main():
                    "hardware raise this (bench.py measures rpc=40 at "
                    "28.4k samples/s in ~22 s calls)")
     p.add_argument("--out", default=None)
+    p.add_argument("--checkpoint-dir", default="/tmp/conv_r03_ckpt",
+                   help="ServerState checkpoints per eval chunk; on "
+                   "restart the run resumes from the latest (tunnel "
+                   "wedges kill multi-hour sessions). '' disables")
     args = p.parse_args()
 
     import jax
@@ -273,7 +320,7 @@ def main():
              "noniid": ["hetero"]}[args.partitions]
     for partition in wants:
         tag = "iid" if partition == "homo" else "noniid_lda0.5"
-        hist, wall, med_s, cfg = run_northstar_once(
+        hist, wall, med_s, cfg, resumed_from = run_northstar_once(
             partition, args, f"[{tag}]"
         )
         evals = [h for h in hist if "test_acc" in h]
@@ -283,10 +330,19 @@ def main():
             "final_test_acc": evals[-1]["test_acc"] if evals else None,
             "rounds_to_target": rounds_to_target(hist, target),
             "wall_clock_s": round(wall, 1),
-            "wall_clock_per_round_s": round(wall / args.rounds, 2),
+            # rounds run IN THIS PROCESS (a resumed run does fewer)
+            "wall_clock_per_round_s": round(wall / max(1, len(hist)), 2),
             "steady_state_s_per_round_median": (
                 round(med_s, 2) if med_s is not None else None
             ),
+            # a resumed process only holds post-resume history: the
+            # trajectory below starts at this round and rounds_to_target
+            # may miss an earlier first-crossing — rebuild the complete
+            # artifact from the streamed logs (convergence_from_log.py)
+            # when this is set
+            **({"resumed_from_round": resumed_from,
+                "trajectory_truncated_before_resume": True}
+               if resumed_from else {}),
             "trajectory": trajectory_rows(hist),
         }
         # incremental write after EVERY partition: a multi-hour two-run

@@ -54,6 +54,15 @@ class FedNASConfig:
     arch_lr: float = 3e-4         # reference --arch_learning_rate
     arch_weight_decay: float = 1e-3
     lambda_train_regularizer: float = 1.0   # reference --lambda_train_regularizer
+    # 1 = the reference FedNAS client's Architect.step_v2 alternation
+    # (∇α L_val + λ·∇α L_train); 2 = the DARTS second-order UNROLLED
+    # architect (``darts/architect.py:32-93``): the alpha gradient of
+    # the validation loss at w' = one SGD(+momentum+wd) step of the
+    # train loss — exact here via jax.grad through the unrolled step,
+    # where the reference needs a finite-difference Hessian-vector
+    # approximation (eq. 8) because torch can't differentiate through
+    # its optimizer's in-place update
+    arch_order: int = 1
     seed: int = 0
 
 
@@ -76,6 +85,40 @@ def cosine_epoch_schedule(lr: float, lr_min: float, epochs: int,
         )
 
     return schedule
+
+
+def darts_unrolled_alpha_grad(train_loss_fn, val_loss_fn, params, alphas,
+                              *, eta, momentum=0.0, weight_decay=0.0,
+                              buf=None):
+    """Second-order DARTS architect gradient (paper eq. 7; reference
+    ``darts/architect.py:32-93``): ∇α L_val(w′(α), α) with
+
+        w′(α) = w − η · (momentum·buf + ∇w L_train(w, α) + wd·w)
+
+    — the reference's ``_compute_unrolled_model`` exactly.  The total
+    derivative (the direct ∇α term plus the implicit
+    −η·∇²αw L_train·∇w′ L_val term, which the reference approximates by
+    central finite differences around w ± R·∇w′L_val,
+    ``_hessian_vector_product:229-246``) is one exact ``jax.grad``
+    through the unrolled step — no Hessian-vector approximation and no
+    ``_construct_model_from_theta`` flatten/unflatten gymnastics.
+
+    ``train_loss_fn(params, alphas)`` and ``val_loss_fn(params, alphas)``
+    must be scalar-valued; ``buf`` is the weight optimizer's momentum
+    buffer pytree (None = zeros, torch's fresh-optimizer except-path).
+    """
+    if buf is None:
+        buf = jax.tree_util.tree_map(jnp.zeros_like, params)
+
+    def val_at_unrolled(alphas_):
+        gw = jax.grad(train_loss_fn)(params, alphas_)
+        new_p = jax.tree_util.tree_map(
+            lambda w, g, b: w - eta * (momentum * b + g + weight_decay * w),
+            params, gw, buf,
+        )
+        return val_loss_fn(new_p, alphas_)
+
+    return jax.grad(val_at_unrolled)(alphas)
 
 
 class SearchState(NamedTuple):
@@ -114,10 +157,14 @@ class FedNASSearch:
         from fedml_tpu.core.client import make_client_optimizer
 
         cfg = self.cfg
+        if cfg.arch_order not in (1, 2):
+            raise ValueError(f"arch_order must be 1 or 2, got "
+                             f"{cfg.arch_order}")
         bundle = self.bundle
+        sched = cosine_epoch_schedule(cfg.lr, cfg.lr_min, cfg.epochs,
+                                      self.steps)
         w_opt = make_client_optimizer(
-            "sgd", cosine_epoch_schedule(cfg.lr, cfg.lr_min, cfg.epochs,
-                                         self.steps),
+            "sgd", sched,
             momentum=cfg.momentum,
             weight_decay=cfg.weight_decay, grad_clip=cfg.grad_clip,
         )
@@ -142,6 +189,40 @@ class FedNASSearch:
         w_grad = jax.value_and_grad(w_loss, has_aux=True)
         a_grad = jax.grad(a_loss)
 
+        def _state_leaf(state, typ):
+            """First optimizer-state node of the given optax type (the
+            momentum TraceState / schedule ScaleByScheduleState inside
+            the w_opt chain), or None."""
+            for leaf in jax.tree_util.tree_leaves(
+                state, is_leaf=lambda n: isinstance(n, typ)
+            ):
+                if isinstance(leaf, typ):
+                    return leaf
+            return None
+
+        def unrolled_alpha_grad(alphas, variables, w_state,
+                                bx, by, bm, bvx, bvy, bvm):
+            """The module-level ``darts_unrolled_alpha_grad`` wired to
+            this round's batches: η is the schedule's CURRENT value and
+            buf the live momentum buffer, both read from the w_opt
+            state (torch reads the same two from its network optimizer,
+            ``architect.py:37-42``)."""
+            params = variables["params"]
+            others = {k: v for k, v in variables.items() if k != "params"}
+            trace = _state_leaf(w_state, optax.TraceState)
+            cnt = _state_leaf(w_state, optax.ScaleByScheduleState)
+            eta = (sched(cnt.count) if callable(sched) and cnt is not None
+                   else (cfg.lr if callable(sched) else sched))
+            return darts_unrolled_alpha_grad(
+                lambda p, a: w_loss(p, others, a, bx, by, bm)[0],
+                lambda p, a: a_loss(a, {**others, "params": p},
+                                    bvx, bvy, bvm),
+                params, alphas,
+                eta=eta, momentum=cfg.momentum,
+                weight_decay=cfg.weight_decay,
+                buf=trace.trace if trace is not None else None,
+            )
+
         def one_client(variables, alphas, x, y, m, vx, vy, vm):
             n_valid = vx.shape[0]
             w_state = w_opt.init(variables["params"])
@@ -155,13 +236,18 @@ class FedNASSearch:
                 vi = bi % n_valid
                 bvx, bvy, bvm = vx[vi], vy[vi], vm[vi]
                 old_alphas = alphas
-                # architect step_v2: g_val + λ_train · g_train
-                g_train = a_grad(alphas, variables, bx, by, bm)
-                g_val = a_grad(alphas, variables, bvx, bvy, bvm)
-                g = jax.tree_util.tree_map(
-                    lambda gv, gt: gv + cfg.lambda_train_regularizer * gt,
-                    g_val, g_train,
-                )
+                if cfg.arch_order == 2:
+                    # unrolled second-order architect (see above)
+                    g = unrolled_alpha_grad(alphas, variables, w_state,
+                                            bx, by, bm, bvx, bvy, bvm)
+                else:
+                    # architect step_v2: g_val + λ_train · g_train
+                    g_train = a_grad(alphas, variables, bx, by, bm)
+                    g_val = a_grad(alphas, variables, bvx, bvy, bvm)
+                    g = jax.tree_util.tree_map(
+                        lambda gv, gt: gv + cfg.lambda_train_regularizer * gt,
+                        g_val, g_train,
+                    )
                 a_up, a_state = a_opt.update(g, a_state, alphas)
                 alphas = optax.apply_updates(alphas, a_up)
                 # weight step

@@ -72,13 +72,25 @@ def masked_multilabel_bce(logits: jax.Array, y: jax.Array, mask: jax.Array):
     """Multi-label tag prediction: per-sample BCE summed over the label
     axis, plus the reference's exact-match / precision / recall metrics
     (``standalone/fedavg/my_model_trainer_tag_prediction.py:24,54-96``:
-    ``nn.BCELoss(reduction='sum')`` on sigmoid outputs ≡ BCE-with-logits
-    here; ``predicted = (pred > .5)``; "correct" counts samples whose
-    ENTIRE tag vector matches).
+    ``nn.BCELoss(reduction='sum')`` on sigmoid outputs; ``predicted =
+    (pred > .5)``; "correct" counts samples whose ENTIRE tag vector
+    matches).
 
     Shapes: logits [B, C] (or [..., C]), y multi-hot [..., C] float,
-    mask [...] per-sample.  Loss = masked mean over samples of the
+    mask [...] per-sample.  Loss = masked MEAN over samples of the
     per-sample label-summed BCE.
+
+    Deliberate deviation from the reference TRAINING objective: the
+    reference optimizes the raw ``reduction='sum'`` value, so its
+    gradient scales with the per-client batch/sample count and its
+    published stackoverflow_lr lr is tuned to that scale.  Here the loss
+    is the per-sample mean (count-invariant gradients — the convention
+    every other loss in this module follows, and the one that keeps one
+    lr meaningful across heterogeneous client sizes).  Reference lr
+    values for this task must be rescaled by the per-client batch size
+    (lr_here ≈ lr_ref × batch_size); the sum is still reported as
+    ``loss_sum`` so METRICS match the reference exactly.  See
+    PARITY.md §losses.
     """
     logits = logits.astype(jnp.float32).reshape(y.shape)
     yf = y.astype(jnp.float32)

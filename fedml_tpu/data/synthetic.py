@@ -78,6 +78,39 @@ def synthetic_alpha_beta(
     )
 
 
+def _gaussian_blur_hw(a: np.ndarray, sigma: float) -> np.ndarray:
+    """Separable Gaussian blur over the H, W axes of [..., H, W, C]
+    (reflect padding), in plain numpy — no scipy dependency."""
+    if a.ndim < 4:
+        # (num_classes, H, W, C) minimum: with a flat input_shape the
+        # axis arithmetic below would blur the feature axis and then the
+        # CLASS axis, silently collapsing class separation
+        raise ValueError(
+            "smooth_sigma requires an image-shaped input_shape (H, W, C); "
+            f"got prototype array of shape {a.shape}"
+        )
+    radius = max(1, int(3.0 * sigma))
+    t = np.arange(-radius, radius + 1)
+    k = np.exp(-(t**2) / (2.0 * sigma**2))
+    k /= k.sum()
+
+    def conv_axis(x, axis):
+        xp = np.concatenate(
+            [np.flip(x.take(range(1, radius + 1), axis=axis), axis=axis),
+             x,
+             np.flip(x.take(range(x.shape[axis] - radius - 1,
+                                  x.shape[axis] - 1), axis=axis),
+                     axis=axis)],
+            axis=axis,
+        )
+        out = np.zeros_like(x)
+        for i, w in enumerate(k):
+            out += w * xp.take(range(i, i + x.shape[axis]), axis=axis)
+        return out
+
+    return conv_axis(conv_axis(a, a.ndim - 3), a.ndim - 2)
+
+
 def synthetic_classification(
     num_train: int = 6000,
     num_test: int = 1000,
@@ -90,6 +123,8 @@ def synthetic_classification(
     label_noise: float = 0.0,
     seed: int = 0,
     name: str = "synthetic",
+    smooth_sigma: float = 0.0,
+    flip_symmetric: bool = False,
 ) -> FedDataset:
     """Class-prototype Gaussian data with the same shapes as a real dataset.
 
@@ -99,9 +134,32 @@ def synthetic_classification(
     accuracy, giving the task a documented irreducible-error ceiling —
     saturating trajectories can't distinguish a correct FedAvg from a
     subtly wrong one (VERDICT r2 missing #1).  Partitioning uses the
-    NOISY labels, as real noisy data would."""
+    NOISY labels, as real noisy data would.
+
+    ``smooth_sigma`` / ``flip_symmetric`` give the class signal the two
+    statistics of natural images that make the reference's augmentation
+    recipe (RandomCrop + RandomHorizontalFlip + Cutout,
+    ``fedml_api/data_preprocessing/cifar10/data_loader.py:57-99``)
+    label-PRESERVING: spatial smoothness (a few-pixel crop shift keeps
+    prototype autocorrelation exp(-d²/4σ²) instead of zero, as for iid
+    pixels) and horizontal-flip invariance (p ← (p + flip_W(p))/√2, so a
+    flipped sample carries the same class signal).  Measured on the real
+    chip: with iid-pixel prototypes the augmented north-star run is
+    pinned at chance (train acc 0.11 after 12 rounds) — the recipe
+    erases an iid-pixel signal entirely.  Prototypes are post-processed
+    only (re-normalized to unit per-pixel std), so the RNG stream and
+    every default-parameter output are unchanged."""
     rng = np.random.RandomState(seed)
     protos = rng.normal(0, 1, (num_classes, *input_shape)).astype(np.float32)
+    if smooth_sigma > 0.0:
+        protos = _gaussian_blur_hw(protos, smooth_sigma)
+    if flip_symmetric:
+        protos = (protos + protos[:, :, ::-1, :]) / np.sqrt(2.0)
+    if smooth_sigma > 0.0 or flip_symmetric:
+        # restore unit per-pixel signal std so `noise` keeps meaning
+        # the same signal-to-noise ratio as the unsmoothed task
+        protos /= protos.std(axis=(1, 2, 3), keepdims=True)
+        protos = protos.astype(np.float32)
 
     def make(n, sd):
         r = np.random.RandomState(sd)

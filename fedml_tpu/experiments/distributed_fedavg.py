@@ -113,6 +113,17 @@ def run_server(args) -> None:
     from fedml_tpu.core.types import cohort_steps_per_epoch
 
     steps = cohort_steps_per_epoch(ds, args.batch_size)
+    if not args.round_timeout:
+        # clients dial with auto_reconnect (run_client below): frames
+        # routed while a client is disconnected are LOST, so a SYNC that
+        # lands in a reconnect window leaves the server waiting forever
+        # for an upload the client never saw.  Reconnect tolerance
+        # relies on the round deadline to move on without it (advisor
+        # r3) — run without one only if clients never drop.
+        print("WARNING: no --round-timeout with auto-reconnecting "
+              "clients: a SYNC lost during a client's reconnect window "
+              "deadlocks the round; set --round-timeout to tolerate "
+              "connection drops", file=sys.stderr, flush=True)
     server = FedAvgServerManager(
         backend, init, num_clients=args.num_clients,
         clients_per_round=args.clients_per_round or args.num_clients,

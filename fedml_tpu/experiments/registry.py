@@ -124,11 +124,23 @@ def shrink_dataset(
     test_x, test_y = ds.test_x, ds.test_y
     test_idx = ds.test_client_idx
     if max_test_samples and len(test_y) > max_test_samples:
-        test_x = test_x[:max_test_samples]
-        test_y = test_y[:max_test_samples]
+        # deterministic STRIDED selection, not a prefix: folder-tree
+        # loaders (imagefolder/CINIC) emit test arrays grouped by class,
+        # so a [:N] prefix collapses the smoke test set to one or two
+        # classes (advisor r3 — the pitfall cifar.py:142 documents)
+        import numpy as _np
+
+        keep = _np.linspace(0, len(test_y) - 1, max_test_samples,
+                            dtype=_np.int64)
+        test_x = test_x[keep]
+        test_y = test_y[keep]
         if test_idx is not None:
+            # remap kept global positions to their new compacted index
+            pos = {int(g): i for i, g in enumerate(keep)}
             test_idx = {
-                c: idx[idx < max_test_samples] for c, idx in test_idx.items()
+                c: _np.asarray([pos[int(g)] for g in idx if int(g) in pos],
+                               dtype=_np.int64)
+                for c, idx in test_idx.items()
             }
     return _dc.replace(
         ds, train_client_idx=train_idx, test_x=test_x, test_y=test_y,

@@ -73,6 +73,7 @@ class ExperimentConfig:
     arch_lr: float = 3e-4
     lr_min: float = 0.001  # cosine weight-LR floor (--learning_rate_min)
     lambda_train_regularizer: float = 1.0
+    arch_order: int = 1  # 2 = unrolled second-order DARTS architect
     # fedgkt
     temperature: float = 3.0
     alpha_kd: float = 1.0
@@ -378,6 +379,7 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
                 epochs=cfg.epochs, batch_size=cfg.batch_size, lr=cfg.lr,
                 lr_min=cfg.lr_min, arch_lr=cfg.arch_lr,
                 lambda_train_regularizer=cfg.lambda_train_regularizer,
+                arch_order=cfg.arch_order,
                 seed=cfg.seed,
             ))
         hist = search.run()

@@ -41,6 +41,37 @@ def test_augment_shapes_and_determinism():
     np.testing.assert_allclose(np.asarray(ident(rng, x)), np.asarray(x))
 
 
+def test_synthetic_natural_image_statistics():
+    """smooth_sigma/flip_symmetric give the stand-in the two statistics
+    that make the reference crop/flip/cutout recipe label-preserving
+    (measured on the chip: iid-pixel prototypes + that recipe = chance);
+    defaults must stay bit-identical to the r3 generator."""
+    from fedml_tpu.data.synthetic import synthetic_classification
+
+    kw = dict(num_train=2000, num_test=100, input_shape=(32, 32, 3),
+              num_clients=4, partition="homo", seed=7)
+    base = synthetic_classification(**kw)
+    again = synthetic_classification(**kw, smooth_sigma=0.0,
+                                     flip_symmetric=False)
+    np.testing.assert_array_equal(base.train_x, again.train_x)
+
+    nat = synthetic_classification(**kw, smooth_sigma=2.0,
+                                   flip_symmetric=True, noise=0.0)
+    p = nat.train_x[0]
+    # flip-invariant class signal
+    np.testing.assert_allclose(p, p[:, ::-1, :], rtol=0, atol=1e-5)
+    # spatially smooth: 2-px shift keeps strong autocorrelation (iid
+    # pixels would give ~0); unit per-pixel signal power preserved
+    sh = np.roll(p, 2, axis=1)
+    corr = np.corrcoef(p.ravel(), sh.ravel())[0, 1]
+    assert corr > 0.6, corr
+    assert abs(p.std() - 1.0) < 0.05
+    # labels still follow prototypes: same label => same clean image
+    y = nat.train_y
+    same = np.where(y == y[0])[0]
+    np.testing.assert_allclose(nat.train_x[same[1]], p, atol=1e-5)
+
+
 def test_shakespeare_loaders():
     ds = load_shakespeare(data_dir="/nonexistent", num_clients=3,
                           windows_per_client=4)

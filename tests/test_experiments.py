@@ -156,6 +156,30 @@ def test_shrink_dataset_caps_shards():
     assert shrink_dataset(ds) is ds
 
 
+def test_shrink_dataset_strided_test_slice_keeps_classes():
+    """Folder-tree loaders emit CLASS-GROUPED test arrays; a [:N] prefix
+    slice would collapse the smoke test set to one class (advisor r3).
+    The strided selection must keep every class represented and remap
+    test_client_idx to compacted positions pointing at the same rows."""
+    import dataclasses
+
+    from fedml_tpu.experiments.registry import shrink_dataset
+
+    ds = load_data("synthetic", num_clients=4)
+    order = np.argsort(ds.test_y, kind="stable")  # class-grouped layout
+    grouped = dataclasses.replace(
+        ds, test_x=ds.test_x[order], test_y=ds.test_y[order],
+        test_client_idx={0: np.arange(len(ds.test_y))},
+    )
+    small = shrink_dataset(grouped, max_test_samples=30)
+    assert len(small.test_y) == 30
+    assert len(np.unique(small.test_y)) == ds.num_classes
+    # the client owned every test row before the shrink, so its remapped
+    # indices must cover exactly the 30 compacted positions
+    kept = small.test_client_idx[0]
+    assert sorted(int(i) for i in kept) == list(range(30))
+
+
 def test_multilabel_bce_matches_reference_semantics():
     """masked_multilabel_bce vs torch BCELoss(sum) + the reference's
     exact-match/precision/recall math on random multi-hot labels."""

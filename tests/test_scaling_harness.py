@@ -86,3 +86,33 @@ def test_convergence_median_round_seconds():
     assert abs(med - 35.0) < 0.01, med
 
     assert median_round_seconds([0.0]) is None
+
+
+def test_from_log_merges_resumed_continuation():
+    """A resumed continuation log has FEWER rows but LATER rounds than
+    the pre-crash log; the merge must keep the post-resume trajectory
+    (later rounds win on overlap) instead of picking by row count
+    (advisor r3)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), os.pardir, "tools"))
+    from convergence_from_log import pick_runs, summarize
+
+    def rows(rounds, accs, dt=10.0):
+        return [{"round": r, "test_acc": a, "test_loss": 1.0,
+                 "elapsed_s": (i + 1) * dt}
+                for i, (r, a) in enumerate(zip(rounds, accs))]
+
+    # pre-crash: rounds 0..6 (7 rows); continuation resumes at 4: 4..9
+    pre = rows(range(0, 7), [0.1, 0.2, 0.3, 0.4, 0.45, 0.5, 0.55])
+    cont = rows(range(4, 10), [0.46, 0.51, 0.56, 0.6, 0.65, 0.7])
+    merged = pick_runs([("pre.log", {"iid": pre}),
+                        ("cont.log", {"iid": cont})])
+    out = summarize(merged["iid"], target=0.6)
+    assert out["rounds_completed"] == 10
+    assert out["final_test_acc"] == 0.7
+    # overlap rounds 4-6 must hold the continuation's rerun values
+    traj = {t["round"]: t["test_acc"] for t in out["trajectory"]}
+    assert traj[4] == 0.46 and traj[6] == 0.56
+    assert out["rounds_to_target"] == 7
+    # wall-clock sums the per-segment elapsed, never mixes clocks
+    assert out["wall_clock_s"] == 70.0 + 60.0

@@ -21,7 +21,7 @@ import sys
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from convergence_run import (build_comparison,  # noqa: E402
-                             median_round_seconds, northstar_metadata,
+                             northstar_metadata, per_round_seconds,
                              rounds_to_target, trajectory_rows)
 
 
@@ -41,35 +41,57 @@ def parse_log(path):
 
 
 def pick_runs(per_log):
-    """One row-list per tag across logs.  Same-tag rows from DIFFERENT
-    logs are never concatenated (each log's elapsed_s restarts at 0, so
-    a blind merge corrupts wall-clock, the steady-state median, and
-    mixes stale partial rounds with rerun rounds) — the log with the
-    most completed rounds wins, with a stderr note."""
+    """One merged trajectory per tag across logs, plus the per-log
+    segments for wall-clock stats.
+
+    A resumed continuation log holds FEWER rows but LATER rounds than
+    the pre-crash log (e.g. rounds 44-99 after a crash at 60), so
+    picking by row count silently drops the post-resume trajectory
+    (r3 advisor finding).  Instead the rows are merged by round index:
+    logs are applied in order of their last round, so on an overlap
+    (pre-crash rounds past the resume checkpoint) the continuation's
+    rerun row wins.  elapsed_s restarts at 0 per log, so wall-clock
+    stats are computed per SEGMENT and pooled, never across the merge
+    boundary."""
     chosen = {}
     for log, runs in per_log:
         for tag, rows in runs.items():
-            if tag in chosen and len(chosen[tag][1]) >= len(rows):
-                print(f"note: {tag} also in {log} ({len(rows)} rows) — "
-                      f"keeping {chosen[tag][0]} "
-                      f"({len(chosen[tag][1])} rows)", file=sys.stderr)
-                continue
-            if tag in chosen:
-                print(f"note: {tag} in {chosen[tag][0]} superseded by "
-                      f"{log} ({len(rows)} rows)", file=sys.stderr)
-            chosen[tag] = (log, rows)
-    return {tag: rows for tag, (log, rows) in chosen.items()}
+            if rows:
+                chosen.setdefault(tag, []).append((log, rows))
+    out = {}
+    for tag, entries in chosen.items():
+        entries.sort(key=lambda e: e[1][-1]["round"])
+        if len(entries) > 1:
+            spans = ", ".join(
+                f"{os.path.basename(l)} [{r[0]['round']}-{r[-1]['round']}]"
+                for l, r in entries)
+            print(f"note: {tag} merged from {spans} (later rounds win "
+                  "on overlap)", file=sys.stderr)
+        byround = {}
+        for _, rows in entries:
+            for r in rows:
+                byround[r["round"]] = r
+        merged = [byround[k] for k in sorted(byround)]
+        out[tag] = (merged, [rows for _, rows in entries])
+    return out
 
 
-def summarize(rows, target):
+def summarize(merged_and_segments, target):
+    rows, segments = merged_and_segments
     evals = [r for r in rows if "test_acc" in r]
-    stamps = [0.0] + [r["elapsed_s"] for r in rows]
-    med = median_round_seconds(stamps)
+    per_round = []
+    for seg in segments:
+        per_round.extend(per_round_seconds([0.0] + [r["elapsed_s"]
+                                                    for r in seg]))
+    per_round.sort()
+    med = per_round[len(per_round) // 2] if per_round else None
     return {
         "rounds_completed": rows[-1]["round"] + 1 if rows else 0,
         "final_test_acc": evals[-1]["test_acc"] if evals else None,
         "rounds_to_target": rounds_to_target(rows, target),
-        "wall_clock_s": stamps[-1] if stamps else None,
+        # sum of segment walls: the run's total on-chip time across
+        # crash/resume sessions (tunnel stalls included)
+        "wall_clock_s": round(sum(s[-1]["elapsed_s"] for s in segments), 1),
         "steady_state_s_per_round_median": (
             round(med, 2) if med is not None else None
         ),
@@ -83,7 +105,12 @@ def main():
                    help="one or more convergence_run logs; their [tag] "
                    "rows are merged (e.g. an iid log + a noniid rerun "
                    "after a tunnel wedge)")
-    p.add_argument("--out", default="CONVERGENCE_r03.json")
+    p.add_argument("--out", default="CONVERGENCE_r04.json")
+    # config-fidelity flags (like --rounds below): the reconstructed
+    # artifact must describe the run the LOG came from
+    p.add_argument("--augment", type=int, choices=[0, 1], default=1)
+    p.add_argument("--smooth-sigma", type=float, default=2.0)
+    p.add_argument("--flip-symmetric", type=int, choices=[0, 1], default=1)
     p.add_argument("--label-noise", type=float, default=0.1)
     p.add_argument("--noise", type=float, default=1.2)
     # config-fidelity flags: the reconstructed artifact's config section
@@ -98,13 +125,28 @@ def main():
     ceiling = 1.0 - args.label_noise
     target = 0.9 * ceiling
     merged = pick_runs([(log, parse_log(log)) for log in args.logs])
+    # this tool reconstructs NORTH-STAR artifacts only: summarizing a
+    # [mnist_lr] (or other-preset) log with the north-star target and
+    # resnet56 config header would misdescribe the run — the mnist_lr
+    # preset streams its own resume-merged .partial artifact instead
+    # (trajectory AND wall-clock survive crashes there)
+    for tag in [t for t in merged if t not in ("iid", "noniid_lda0.5")]:
+        print(f"note: dropping [{tag}] rows — not a north-star tag; "
+              "this tool only reconstructs the north-star pair",
+              file=sys.stderr)
+        del merged[tag]
+    if not merged:
+        raise SystemExit("no [iid]/[noniid_lda0.5] rows in the logs")
     runs = {tag: summarize(rows, target) for tag, rows in merged.items()}
     out = {
         **northstar_metadata(noise=args.noise,
                              label_noise=args.label_noise,
                              epochs=args.epochs, rounds=args.rounds,
                              num_train=args.num_train,
-                             num_test=args.num_test),
+                             num_test=args.num_test,
+                             augment=bool(args.augment),
+                             smooth_sigma=args.smooth_sigma,
+                             flip_symmetric=bool(args.flip_symmetric)),
         "provenance": "reconstructed from the streamed run logs "
                       f"({', '.join(os.path.basename(l) for l in args.logs)}) "
                       "by tools/convergence_from_log.py",
@@ -112,9 +154,7 @@ def main():
         "runs": runs,
     }
     if {"iid", "noniid_lda0.5"} <= set(runs):
-        out["comparison"] = build_comparison(
-            runs, {t: r["trajectory"] for t, r in runs.items()}
-        )
+        out["comparison"] = build_comparison(runs)
     with open(args.out, "w") as f:
         json.dump(out, f, indent=1)
     print(json.dumps({t: {"final": r["final_test_acc"],

@@ -17,23 +17,22 @@ or a cited hardware constant:
   up to 16x16 = 256 chips (public v5e spec / jax-ml scaling book).  The
   model conservatively uses ONE axis, ONE direction — a real 2D
   bidirectional torus is up to 4x faster.
-- ``hop_latency``: 1 us/hop, ring diameter N/2 hops — also conservative
-  (ICI hop latency is sub-microsecond).
+- ``hop_latency``: 1 us/hop over the 2(N-1) sequential ring steps —
+  conservative (ICI hop latency is sub-microsecond).
 
 Weak-scaling scenario (SURVEY.md §7.8 north star): clients-per-chip
 fixed, chips grow; per round each chip trains its resident clients
 (t_compute, constant) then joins ONE all-reduce of the variable tree
 (``lax.psum`` over the ``clients`` mesh axis — ``parallel/spmd.py``).
 
-    t_allreduce(N) = 2 * V * (N-1)/N / ici_bw  +  (N/2) * hop_latency
+    t_allreduce(N) = 2 * V * (N-1)/N / ici_bw  +   2 * (N-1) * hop_latency
     efficiency(N)  = t_compute / (t_compute + t_allreduce(N))
 
 The communication/compute ratio is what makes federated rounds scale:
 one 2.4 MB all-reduce amortized over E local epochs of ResNet-56
-training (~540 ms) is a ~4e-4 overhead — efficiency stays >99% through
-256 chips even with the conservative single-axis model.  Cross-host DCN
-(beyond one 256-chip slice) at 2.5e10 B/s/host raises it to ~2e-4 s,
-still >99%.
+training (~530 ms) is a ~1.2e-3 overhead at 256 chips — efficiency
+stays >99% even with the conservative single-axis model.  Cross-host
+DCN (beyond one 256-chip slice) at 2.5e10 B/s/host stays >99% too.
 
 Usage: python tools/scaling_model.py [--measure] [--out SCALING_r03.json]
   --measure re-times the workload on the local chip (else uses
@@ -153,8 +152,9 @@ def main():
                     chips[-1]["t_allreduce_ms"] / 1e3 / t_compute, 6
                 ),
                 "claim": ">=90% weak-scaling efficiency 8->256 chips "
-                         "holds with >10x margin: one small all-reduce "
-                         "per E-epoch round is ~4e-4 of round time",
+                         "holds with large margin: one small all-reduce "
+                         "per E-epoch round is ~1.2e-3 of round time "
+                         "at 256 chips",
             },
         },
     }

@@ -10,8 +10,10 @@ exist, ``trainer.py:6`` — SURVEY.md §7; this is the working rebuild.)
 TPU mapping (SURVEY.md §2.6): groups ↔ ICI slices, the global tier ↔
 DCN — a nested (``group``, ``clients``) mesh does the intra-group psum
 on ICI and the rare global average across slices.  This module is the
-single-host simulation sharing the FedAvg round kernel; the mesh layout
-note lives in ``fedml_tpu.parallel.spmd``.
+single-host simulation sharing the FedAvg round kernel; the MESH form
+is ``fedml_tpu.parallel.spmd.make_hierarchical_spmd_round_fn`` (one
+shard_map program, two-level psum), parity-certified against this
+simulation in ``tests/test_spmd.py`` and the driver dryrun.
 """
 
 from __future__ import annotations

@@ -228,3 +228,149 @@ def shard_client_block_local(
 def replicate(mesh: Mesh, tree: PyTree) -> PyTree:
     sharding = NamedSharding(mesh, P())
     return jax.device_put(tree, sharding)
+
+
+# ---------------------------------------------------------------------------
+# Hierarchical (two-tier) FL on a nested (group, clients) mesh
+# ---------------------------------------------------------------------------
+
+
+def make_group_mesh(num_groups: int, n_devices: Optional[int] = None) -> Mesh:
+    """Nested mesh for two-tier FL: ``group`` (slow axis — slices/DCN)
+    × ``clients`` (fast axis — chips within a slice/ICI)."""
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    n = len(devices)
+    if n % num_groups:
+        raise ValueError(f"{n} devices not divisible into {num_groups} groups")
+    arr = np.array(devices).reshape(num_groups, n // num_groups)
+    return Mesh(arr, axis_names=("group", "clients"))
+
+
+def hierarchical_pack(dataset, groups, batch_size, steps_per_epoch, seed):
+    """Stack per-group device-resident packs into one [G*C, ...] block
+    in group-major order (the ``P(("group", "clients"))`` layout), plus
+    the matching global slot ids.  Uses the exact per-group pack the
+    host simulation builds (``HierarchicalSimulation._group_pack``), so
+    the SPMD program sees bit-identical client shards."""
+    from fedml_tpu.core.types import device_resident_pack
+
+    sizes = {g: len(ids) for g, ids in groups.items()}
+    if len(set(sizes.values())) != 1:
+        raise ValueError(
+            f"nested-mesh hierarchical FL needs equal group sizes, got "
+            f"{sizes}; pad the grouping or drop stragglers"
+        )
+    blocks, all_ids = [], []
+    for g in sorted(groups):
+        ids = np.asarray(groups[g])
+        args, _ = device_resident_pack(
+            dataset, ids, batch_size, steps_per_epoch=steps_per_epoch,
+            seed=seed,
+        )
+        blocks.append(args)
+        all_ids.append(ids)
+    stacked = tuple(
+        jnp.concatenate([jnp.asarray(b[i]) for b in blocks], axis=0)
+        for i in range(len(blocks[0]))
+    )
+    return stacked, np.concatenate(all_ids)
+
+
+def make_hierarchical_spmd_round_fn(
+    mesh: Mesh,
+    local_update: LocalUpdateFn,
+    *,
+    group_comm_round: int,
+    server_update=None,
+    aggregate_transform=None,
+):
+    """One GLOBAL hierarchical round as ONE shard_map program on a
+    (``group``, ``clients``) mesh — the SURVEY §2.6 mapping the host
+    simulation (``algorithms/hierarchical.py``) documents: every group
+    starts from the global model, runs ``group_comm_round`` in-group
+    FedAvg rounds whose aggregation is a masked-psum over the
+    ``clients`` axis ONLY (intra-slice, rides ICI), and the global tier
+    is one sample-weighted psum over the ``group`` axis (inter-slice,
+    rides DCN) at the end.  Reference semantics:
+    ``standalone/hierarchical_fl/trainer.py:43-69`` +
+    ``group.py:24-46``.
+
+    Parity contract (certified in the driver dryrun and
+    ``tests/test_spmd.py``): with data laid out by ``hierarchical_pack``
+    this program's output equals ``HierarchicalSimulation.run_round``
+    exactly — same per-group key schedule
+    (``fold_in(state.key, 1000 + g)``), same in-group round_idx base
+    (``round_idx * group_comm_round``), same group weights (the group's
+    total sample count).
+    """
+    kwargs = {}
+    if server_update is not None:
+        kwargs["server_update"] = server_update
+    inner = make_round_fn(
+        local_update,
+        aggregate_transform=aggregate_transform,
+        axis_name="clients",
+        **kwargs,
+    )
+    from fedml_tpu.algorithms.fedavg import ServerState
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            P(),                      # state replicated
+            P(("group", "clients")),  # x   [G*C, steps, B, ...]
+            P(("group", "clients")),  # y
+            P(("group", "clients")),  # mask
+            P(("group", "clients")),  # num_samples
+            P(("group", "clients")),  # participation
+            P(("group", "clients")),  # global slot ids
+        ),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def hier_round(state, x, y, mask, num_samples, participation, slot_ids):
+        g = jax.lax.axis_index("group")
+        gstate = ServerState(
+            variables=state.variables,
+            opt_state=state.opt_state,
+            round_idx=state.round_idx * group_comm_round,
+            key=jax.random.fold_in(state.key, 1000 + g),
+        )
+
+        def in_group_round(gs, _):
+            return inner(gs, x, y, mask, num_samples, participation,
+                         slot_ids)
+
+        gstate, ms = jax.lax.scan(
+            in_group_round, gstate, None, length=group_comm_round
+        )
+        # global tier: group models weighted by the group's TOTAL sample
+        # count (reference group.py aggregates over the whole group)
+        group_total = jax.lax.psum(num_samples.sum(), "clients")
+        num = jax.tree_util.tree_map(
+            lambda leaf: jax.lax.psum(
+                group_total * leaf.astype(jnp.float32), "group"
+            ),
+            gstate.variables,
+        )
+        den = jax.lax.psum(group_total, "group")
+        new_vars = jax.tree_util.tree_map(
+            lambda s, ref: (s / jnp.maximum(den, 1e-12)).astype(ref.dtype),
+            num,
+            state.variables,
+        )
+        # host parity: metrics accumulate over EVERY in-group round of
+        # every group (inner already psums across clients)
+        metrics = {k: jax.lax.psum(v.sum(), "group") for k, v in ms.items()}
+        new_state = ServerState(
+            variables=new_vars,
+            opt_state=state.opt_state,
+            round_idx=state.round_idx + 1,
+            key=state.key,
+        )
+        return new_state, metrics
+
+    return jax.jit(hier_round)

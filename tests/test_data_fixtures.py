@@ -499,6 +499,121 @@ def test_edge_case_pickle_and_label_flip_semantics(tmp_path):
     assert len(pd2.train_x) == 300 + 8  # capped at what exists
 
 
+def test_poison_family_matrix(tmp_path):
+    """All five reference poison families through the one poison_type
+    switch, each against a fixture mirroring its on-disk archive format
+    (edge_case_examples/data_loader.py:294-713)."""
+    import pickle
+
+    import torch
+
+    from fedml_tpu.data.edge_case import (
+        HOWTO_GREEN_CAR_TRAIN_IDX,
+        POISON_FAMILIES,
+        load_ardis_test,
+        make_poisoned_dataset,
+    )
+    from fedml_tpu.data.synthetic import synthetic_classification
+
+    rng = np.random.RandomState(0)
+    ds = synthetic_classification(
+        num_train=600, num_test=40, input_shape=(32, 32, 3), num_classes=10,
+        num_clients=4, partition="homo", seed=0,
+    )
+
+    # --- southwest + southwest-da share the pickled-uint8 archive ---
+    with open(tmp_path / "southwest_images_new_train.pkl", "wb") as f:
+        pickle.dump(rng.randint(0, 256, (120, 32, 32, 3), dtype=np.uint8), f)
+    with open(tmp_path / "southwest_images_new_test.pkl", "wb") as f:
+        pickle.dump(rng.randint(0, 256, (5, 32, 32, 3), dtype=np.uint8), f)
+    sw = make_poisoned_dataset(ds, "southwest", str(tmp_path), seed=1,
+                               shuffle=False)
+    assert len(sw.train_x) == 500  # 400 clean + 100 poison
+    np.testing.assert_array_equal(sw.backdoor_test_y, np.full(5, 9))
+
+    da = make_poisoned_dataset(ds, "southwest-da", str(tmp_path), seed=1,
+                               shuffle=False)
+    # archive smaller than the requested poison count: noise must stop
+    # at the REAL poison tail, never touching clean rows (review r4)
+    small = make_poisoned_dataset(ds, "southwest", str(tmp_path), seed=1,
+                                  shuffle=False, num_poison=200)
+    small_da = make_poisoned_dataset(ds, "southwest-da", str(tmp_path),
+                                     seed=1, shuffle=False, num_poison=200)
+    np.testing.assert_array_equal(small_da.train_x[:400],
+                                  small.train_x[:400])
+    # same mixture, but the poison tail carries the AddGaussianNoise
+    # evasion — clean rows identical, poison rows perturbed
+    np.testing.assert_array_equal(da.train_x[:400], sw.train_x[:400])
+    tail_delta = np.abs(da.train_x[400:] - sw.train_x[400:])
+    assert 0.0 < float(tail_delta.mean()) < 0.2  # ~N(0, 0.05) noise
+    np.testing.assert_array_equal(da.train_y, sw.train_y)
+
+    # --- ardis: torch-saved targeted test set (raw tensor AND
+    # .data/.targets dataset object forms) ---
+    mn = synthetic_classification(
+        num_train=600, num_test=40, input_shape=(28, 28, 1), num_classes=10,
+        num_clients=4, partition="homo", seed=0,
+    )
+    torch.save(torch.from_numpy(
+        rng.randint(0, 256, (7, 28, 28), dtype=np.uint8)),
+        tmp_path / "ardis_test_dataset.pt")
+    loaded = load_ardis_test(str(tmp_path))
+    assert loaded is not None and loaded[0].shape == (7, 28, 28, 1)
+    assert float(loaded[0].max()) <= 1.0
+    ar = make_poisoned_dataset(mn, "ardis", str(tmp_path), seed=1,
+                               shuffle=False)
+    assert len(ar.train_x) == 466  # 400 clean + 66 ARDIS-7s
+    assert int((ar.train_y[-66:] == 1).all())  # -> MNIST label 1
+    np.testing.assert_array_equal(ar.backdoor_test_y, np.full(7, 1))
+
+    # MNIST-style dataset object with .data/.targets (a local class
+    # would not unpickle; Namespace round-trips and has the same shape)
+    from argparse import Namespace
+
+    torch.save(Namespace(data=rng.randint(0, 256, (3, 28, 28),
+                                          dtype=np.uint8),
+                         targets=np.array([7, 7, 7])),
+               tmp_path / "ardis_test_dataset.pt")
+    loaded2 = load_ardis_test(str(tmp_path))
+    assert loaded2 is not None and loaded2[0].shape == (3, 28, 28, 1)
+
+    # --- howto: host-distribution green cars by fixed index -> bird ---
+    hw = make_poisoned_dataset(ds, "howto", seed=1, shuffle=False)
+    n_poison = len(HOWTO_GREEN_CAR_TRAIN_IDX)
+    assert len(hw.train_x) == 500  # (500 - 27) clean + 27 poison
+    assert int((hw.train_y[-n_poison:] == 2).all())
+    # poison rows ARE host-dataset rows (index % n on the stand-in)
+    np.testing.assert_array_equal(
+        hw.train_x[-n_poison:],
+        ds.train_x[[i % 600 for i in HOWTO_GREEN_CAR_TRAIN_IDX]],
+    )
+
+    # --- greencar-neo: new-green-cars pickled archive -> bird ---
+    with open(tmp_path / "new_green_cars_train.pkl", "wb") as f:
+        pickle.dump(rng.randint(0, 256, (150, 32, 32, 3), dtype=np.uint8), f)
+    with open(tmp_path / "new_green_cars_test.pkl", "wb") as f:
+        pickle.dump(rng.randint(0, 256, (4, 32, 32, 3), dtype=np.uint8), f)
+    gc = make_poisoned_dataset(ds, "greencar-neo", str(tmp_path), seed=1)
+    assert len(gc.train_x) == 500
+    # default shuffle: poison no longer sits in a droppable tail (the
+    # robust slot packer truncates by prefix) but the mixture content
+    # is unchanged
+    gc_flat = make_poisoned_dataset(ds, "greencar-neo", str(tmp_path),
+                                    seed=1, shuffle=False)
+    assert not (gc.train_y == gc_flat.train_y).all()
+    np.testing.assert_array_equal(np.sort(gc.train_y),
+                                  np.sort(gc_flat.train_y))
+    np.testing.assert_array_equal(gc.backdoor_test_y, np.full(4, 2))
+
+    # unknown family fails loudly; every family has offline fallback
+    with pytest.raises(ValueError, match="poison_type"):
+        make_poisoned_dataset(ds, "nope")
+    for fam in POISON_FAMILIES:
+        host = mn if fam == "ardis" else ds
+        pd = make_poisoned_dataset(host, fam, seed=2)  # no archives
+        assert len(pd.train_x) > 0 and len(pd.backdoor_test_x) > 0
+
+
 # ---------------------------------------------------------------------------
 # Real image-format parsers (VERDICT r2 #3): JPEG folder trees and CSV
 # user-maps, decoded with PIL from tiny generated fixtures.

@@ -177,3 +177,62 @@ def test_host_sharded_packing_matches_single_host():
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
     assert float(ref_metrics["loss_sum"]) == float(got_metrics["loss_sum"])
+
+
+def test_hierarchical_spmd_matches_host_simulation():
+    """One shard_map program on a nested (group, clients) mesh ==
+    HierarchicalSimulation.run_round: two-level psum (intra-group over
+    the clients axis per in-group round, sample-weighted global average
+    over the group axis) with the host driver's exact key schedule and
+    group weights (SURVEY §2.6; reference
+    standalone/hierarchical_fl/trainer.py:43-69)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.algorithms.hierarchical import HierarchicalSimulation
+    from fedml_tpu.parallel.spmd import (hierarchical_pack,
+                                         make_group_mesh,
+                                         make_hierarchical_spmd_round_fn)
+
+    ds = synthetic_classification(
+        num_train=800, num_test=100, input_shape=(12,), num_classes=4,
+        num_clients=8, partition="hetero", partition_alpha=0.5, seed=0,
+    )
+    cfg = FedAvgConfig(num_clients=8, clients_per_round=8, comm_rounds=2,
+                       epochs=2, batch_size=16, lr=0.2, seed=0)
+    sim = HierarchicalSimulation(
+        logistic_regression(12, 4), ds, cfg,
+        num_groups=2, group_comm_round=3,
+    )
+    state0 = sim.state
+
+    mesh = make_group_mesh(2, 8)
+    assert mesh.shape == {"group": 2, "clients": 4}
+    block, ids = hierarchical_pack(
+        ds, sim.groups, cfg.batch_size, sim.steps_per_epoch, cfg.seed
+    )
+    hier = make_hierarchical_spmd_round_fn(
+        mesh, sim.local_update, group_comm_round=3
+    )
+    n = len(ids)
+    got_state, got_metrics = hier(
+        replicate(mesh, state0), *block,
+        jnp.ones(n, jnp.float32), jnp.asarray(ids, jnp.int32),
+    )
+
+    host_metrics = sim.run_round()
+    for a, b in zip(
+        jax.tree_util.tree_leaves(sim.state.variables),
+        jax.tree_util.tree_leaves(got_state.variables),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+    assert int(got_state.round_idx) == 1
+    # metrics accumulate over every (group, in-group round)
+    assert float(got_metrics["count"]) == pytest.approx(
+        host_metrics["count"])
+    assert float(got_metrics["loss_sum"]) == pytest.approx(
+        host_metrics["loss_sum"], rel=1e-5)
+
+    # unequal group sizes must refuse the mesh layout loudly
+    with pytest.raises(ValueError, match="equal group sizes"):
+        hierarchical_pack(ds, {0: [0, 1, 2], 1: [3, 4]}, cfg.batch_size,
+                          sim.steps_per_epoch, cfg.seed)

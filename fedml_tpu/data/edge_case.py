@@ -334,11 +334,12 @@ def make_poisoned_dataset(
             # requested count — noise must never touch clean rows
             tail = min(100 if num_poison is None else num_poison,
                        len(ood_train))
-            noisy = out.train_x.copy()
-            noisy[-tail:] += rng.normal(
-                0.0, 0.05, noisy[-tail:].shape
-            ).astype(np.float32)
-            out = dataclasses.replace(out, train_x=noisy)
+            if tail > 0:  # [-0:] would select (and corrupt) EVERY row
+                noisy = out.train_x.copy()
+                noisy[-tail:] += rng.normal(
+                    0.0, 0.05, noisy[-tail:].shape
+                ).astype(np.float32)
+                out = dataclasses.replace(out, train_x=noisy)
         return _shuffled(out)
 
     if poison_type == "ardis":

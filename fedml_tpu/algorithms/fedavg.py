@@ -560,8 +560,8 @@ class FedAvgSimulation:
                     f"run_fused cannot honor the {hook} override of "
                     f"{type(self).__name__}; use run()"
                 )
+        cfg = self.cfg
         rounds = rounds if rounds is not None else cfg.comm_rounds
-        freq = cfg.frequency_of_the_test
         ids = np.arange(cfg.num_clients)
         x, y, mask, num_samples = self._cohort_block(ids, 0)
         participation = jnp.ones(len(ids), jnp.float32)
@@ -577,10 +577,27 @@ class FedAvgSimulation:
                 ))
             return fns[n]
 
-        # chunks end exactly on run()'s eval rounds (r % freq == 0, plus
-        # the final round) so the recorded history matches the dispatch
-        # loop row-for-row; rounds_per_call additionally caps a chunk
-        # (extra chunk boundaries without evals)
+        def run_chunk(base, n, chunk_ids):
+            del base, chunk_ids
+            self.state, stacked = fused(n)(
+                self.state, x, y, mask, num_samples, participation,
+                slot_ids,
+            )
+            return stacked
+
+        return self._drive_chunks(
+            rounds, rounds_per_call, run_chunk,
+            ids_for_round=lambda r: ids, log_fn=log_fn,
+        )
+
+    def _drive_chunks(self, rounds, rounds_per_call, run_chunk,
+                      *, ids_for_round, log_fn):
+        """Shared chunking scaffold for the fused drivers: chunks end
+        exactly on ``run()``'s eval rounds (r %% freq == 0, plus the
+        final round) so the recorded history matches the dispatch loop
+        row-for-row; ``rounds_per_call`` additionally caps a chunk
+        (extra chunk boundaries without evals); 0/None = uncapped."""
+        freq = self.cfg.frequency_of_the_test
         base0 = int(self.state.round_idx)
         eval_rounds = sorted(
             {r for r in range(base0, base0 + rounds) if r % freq == 0}
@@ -593,9 +610,8 @@ class FedAvgSimulation:
             n = next_eval - base + 1
             if rounds_per_call:
                 n = min(n, rounds_per_call)
-            self.state, stacked = fused(n)(
-                self.state, x, y, mask, num_samples, participation, slot_ids
-            )
+            chunk_ids = [ids_for_round(base + i) for i in range(n)]
+            stacked = run_chunk(base, n, chunk_ids)
             rows = []
             for i in range(n):
                 out = {k: float(v[i]) for k, v in stacked.items()}
@@ -603,6 +619,7 @@ class FedAvgSimulation:
                 if out.get("count", 0) > 0:
                     out["train_acc"] = out["correct"] / out["count"]
                     out["train_loss"] = out["loss_sum"] / out["count"]
+                self._annotate_round(out, chunk_ids[i], base + i)
                 rows.append(out)
             if base + n - 1 in eval_rounds:
                 rows[-1].update(self.evaluate_global())
@@ -644,7 +661,6 @@ class FedAvgSimulation:
                 f"override of {type(self).__name__}; use run()"
             )
         rounds = rounds if rounds is not None else cfg.comm_rounds
-        freq = cfg.frequency_of_the_test
         # ONE jitted program serves every chunk length: the scheduled fn
         # scans the data's leading [R] axis, so jit specializes per
         # input shape on its own (unlike run_fused, where R is baked
@@ -656,17 +672,7 @@ class FedAvgSimulation:
             aggregate_transform=self._aggregate_transform,
         ))
 
-        base0 = int(self.state.round_idx)
-        eval_rounds = sorted(
-            {r for r in range(base0, base0 + rounds) if r % freq == 0}
-            | {base0 + rounds - 1}
-        )
-        done = 0
-        while done < rounds:
-            base = base0 + done
-            next_eval = next(r for r in eval_rounds if r >= base)
-            n = min(next_eval - base + 1, rounds_per_call)
-            chunk_ids = [self._sample_ids(base + i) for i in range(n)]
+        def run_chunk(base, n, chunk_ids):
             blocks = [self._cohort_block(ids, base + i)
                       for i, ids in enumerate(chunk_ids)]
             stacked_args = tuple(
@@ -678,21 +684,9 @@ class FedAvgSimulation:
             self.state, stacked = fused(
                 self.state, *stacked_args, part, sids
             )
-            rows = []
-            for i in range(n):
-                out = {k: float(v[i]) for k, v in stacked.items()}
-                out["round"] = base + i
-                if out.get("count", 0) > 0:
-                    out["train_acc"] = out["correct"] / out["count"]
-                    out["train_loss"] = out["loss_sum"] / out["count"]
-                self._annotate_round(out, chunk_ids[i], base + i)
-                rows.append(out)
-            if base + n - 1 in eval_rounds:
-                rows[-1].update(self.evaluate_global())
-                rows[-1].update(self._extra_eval())
-            self.history.extend(rows)
-            if log_fn:
-                for r in rows:
-                    log_fn(r)
-            done += n
-        return self.history
+            return stacked
+
+        return self._drive_chunks(
+            rounds, rounds_per_call, run_chunk,
+            ids_for_round=self._sample_ids, log_fn=log_fn,
+        )

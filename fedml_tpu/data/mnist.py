@@ -161,6 +161,17 @@ def load_mnist(
             seed=seed,
             name="mnist(synthetic-standin)",
         )
+        if partition == "power_law":
+            # real LEAF MNIST power-law shards are tens-to-hundreds of
+            # samples; the lognormal tail can mint a ~2700-sample
+            # client, and the fixed pack geometry (steps = the GLOBAL
+            # max shard) would pad every sampled cohort block to that
+            # outlier — ~95% padding compute + an ~85 MB/round transfer
+            # (measured; see data/emnist.py for the same fix)
+            cap = 500
+            ds.train_client_idx = {
+                c: idx[:cap] for c, idx in ds.train_client_idx.items()
+            }
         if flatten:
             ds.train_x = ds.train_x.reshape(len(ds.train_x), -1)
             ds.test_x = ds.test_x.reshape(len(ds.test_x), -1)

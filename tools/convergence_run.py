@@ -314,7 +314,11 @@ def main():
     p.add_argument("--num-train", type=int, default=None)
     p.add_argument("--num-test", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
-    p.add_argument("--eval-every", type=int, default=5)
+    p.add_argument("--eval-every", type=int, default=None,
+                   help="test-eval cadence (default: northstar 5, "
+                   "cross-device presets 25 — chunks end on eval "
+                   "rounds, so a tighter cadence also caps the fused "
+                   "chunk length)")
     p.add_argument("--noise", type=float, default=1.2,
                    help="feature noise sigma (cluster overlap hardness; "
                    "1.6 measured too hard — the net memorizes instead of "
@@ -359,6 +363,8 @@ def main():
     if args.rounds is None:
         args.rounds = {"northstar": 100, "mnist_lr": 400,
                        "femnist_cnn": 1500}[args.preset]
+    if args.eval_every is None:
+        args.eval_every = 5 if args.preset == "northstar" else 25
     if args.preset in ("mnist_lr", "femnist_cnn"):
         run_cross_device(args)
         return
@@ -625,8 +631,9 @@ def run_sampled_preset(args, spec):
             "partition": "power_law", "optimizer": "sgd", "lr": cfg.lr,
             "local_epochs": cfg.epochs, "batch_size": cfg.batch_size,
             "rounds": args.rounds,
-            "driver": f"run_fused_sampled (scheduled cohorts, "
-                      f"{rpc} rounds/device call)",
+            "driver": ("run_fused_sampled (scheduled cohorts, "
+                       f"{min(rpc, args.eval_every)} rounds/device call"
+                       " — chunks end on eval rounds)"),
         },
         # merged across crash/resume sessions via the .partial sidecar
         "wall_clock_s": round(prior_wall + time.time() - t0, 1),

@@ -560,7 +560,6 @@ class FedAvgSimulation:
                     f"run_fused cannot honor the {hook} override of "
                     f"{type(self).__name__}; use run()"
                 )
-        cfg = self.cfg
         rounds = rounds if rounds is not None else cfg.comm_rounds
         ids = np.arange(cfg.num_clients)
         x, y, mask, num_samples = self._cohort_block(ids, 0)

@@ -54,8 +54,9 @@ class HierarchicalSimulation(FedAvgSimulation):
         groups: Optional[Dict[int, List[int]]] = None,
         group_method: str = "random",
         loss_fn: LossFn = masked_softmax_ce,
+        **kwargs,
     ):
-        super().__init__(bundle, dataset, config, loss_fn=loss_fn)
+        super().__init__(bundle, dataset, config, loss_fn=loss_fn, **kwargs)
         self.groups = groups or assign_groups(
             config.num_clients, num_groups, group_method, seed=config.seed
         )

@@ -87,6 +87,10 @@ class ExperimentConfig:
     # beyond-reference knobs available on the FedAvg-engine family
     compute_dtype: str = ""  # "bf16" = mixed-precision local training
     drop_prob: float = 0.0  # failure injection: P(client dies mid-round)
+    # the reference's CIFAR-family loaders augment UNCONDITIONALLY
+    # (crop+flip, +Cutout(16) for cifar10/100 — cifar10/data_loader.py:
+    # 57-99, cifar100:85-91, cinic10:91-92); 0 disables for ablations
+    data_augmentation: int = 1
     # smoke-tier shrink knobs (0 = unlimited): cap each client's shard /
     # the test set AFTER the real loader runs — the task is never swapped
     max_samples_per_client: int = 0
@@ -453,6 +457,21 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
     # the FedAvg-engine family
     from fedml_tpu.algorithms import fedavg as fa
 
+    # reference parity: the CIFAR-family loaders bake augmentation into
+    # their train transform — published accuracies are unreachable
+    # without it (measured: the r3 north-star run memorized).  Here it
+    # is the jit-compiled per-epoch augment inside the local update.
+    engine_kw = {}
+    if cfg.data_augmentation and ds.train_x.ndim == 4:
+        from fedml_tpu.data.augment import make_image_augment
+
+        if cfg.dataset in ("cifar10", "cifar100"):
+            engine_kw["augment_fn"] = make_image_augment(
+                pad=4, flip=True, cutout=16)
+        elif cfg.dataset == "cinic10":
+            engine_kw["augment_fn"] = make_image_augment(
+                pad=4, flip=True, cutout=None)
+
     common = dict(
         num_clients=ds.num_clients,
         clients_per_round=cfg.client_num_per_round,
@@ -465,32 +484,33 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
     )
     if cfg.algorithm == "fedavg":
         sim = fa.FedAvgSimulation(bundle, ds, fa.FedAvgConfig(**common),
-                                  loss_fn=loss_fn)
+                                  loss_fn=loss_fn, **engine_kw)
     elif cfg.algorithm == "fedprox":
         from fedml_tpu.algorithms.fedprox import FedProxSimulation
 
         sim = FedProxSimulation(bundle, ds, fa.FedAvgConfig(**common),
-                                mu=cfg.mu, loss_fn=loss_fn)
+                                mu=cfg.mu, loss_fn=loss_fn, **engine_kw)
     elif cfg.algorithm == "fedopt":
         from fedml_tpu.algorithms.fedopt import FedOptSimulation
 
         sim = FedOptSimulation(
             bundle, ds, fa.FedAvgConfig(**common),
             server_optimizer=cfg.server_optimizer, server_lr=cfg.server_lr,
-            loss_fn=loss_fn,
+            loss_fn=loss_fn, **engine_kw,
         )
     elif cfg.algorithm == "fednova":
         nova_cfg = fa.FedAvgConfig(**{**common, "weight_decay": 0.0})
         from fedml_tpu.algorithms.fednova import FedNovaSimulation
 
-        sim = FedNovaSimulation(bundle, ds, nova_cfg, loss_fn=loss_fn)
+        sim = FedNovaSimulation(bundle, ds, nova_cfg, loss_fn=loss_fn,
+                                **engine_kw)
     elif cfg.algorithm == "fedavg_robust":
         from fedml_tpu.algorithms.fedavg_robust import FedAvgRobustSimulation
 
         sim = FedAvgRobustSimulation(
             bundle, ds, fa.FedAvgConfig(**common),
             defense_type=cfg.defense_type, norm_bound=cfg.norm_bound,
-            stddev=cfg.stddev, loss_fn=loss_fn,
+            stddev=cfg.stddev, loss_fn=loss_fn, **engine_kw,
         )
     elif cfg.algorithm == "hierarchical":
         from fedml_tpu.algorithms.hierarchical import HierarchicalSimulation
@@ -498,7 +518,7 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         sim = HierarchicalSimulation(
             bundle, ds, fa.FedAvgConfig(**common),
             num_groups=cfg.group_num, group_comm_round=cfg.group_comm_round,
-            loss_fn=loss_fn,
+            loss_fn=loss_fn, **engine_kw,
         )
     else:
         raise ValueError(f"unknown algorithm: {cfg.algorithm}")

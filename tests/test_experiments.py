@@ -111,6 +111,47 @@ def _matrix_cfg(model, dataset):
     )
 
 
+def test_cifar_dispatcher_wires_reference_augmentation(monkeypatch):
+    """fedavg+cifar-family through the dispatcher must construct the
+    simulation WITH the reference's unconditional CIFAR augmentation
+    (crop+flip+cutout for cifar10/100, no cutout for cinic10 — the
+    published accuracies are unreachable without it), and must NOT
+    augment non-image data or when --data_augmentation 0.  Spied at the
+    constructor (no conv compile needed)."""
+    from fedml_tpu.algorithms import fedavg as fa
+
+    captured = {}
+
+    class _Stop(Exception):
+        pass
+
+    real = fa.FedAvgSimulation
+
+    class Spy(real):
+        def __init__(self, bundle, ds, config, **kw):
+            captured["augment_fn"] = kw.get("augment_fn")
+            raise _Stop
+
+    monkeypatch.setattr(fa, "FedAvgSimulation", Spy)
+
+    def probe(**kw):
+        cfg = dataclasses.replace(ExperimentConfig(
+            algorithm="fedavg", model="resnet20", dataset="cifar10",
+            client_num_in_total=2, client_num_per_round=2, comm_round=1,
+            batch_size=8, max_samples_per_client=16, max_test_samples=16,
+        ), **kw)
+        captured.clear()
+        with pytest.raises(_Stop):
+            run_experiment(cfg, log_fn=None)
+        return captured["augment_fn"]
+
+    assert probe() is not None                        # cifar10: on
+    assert probe(dataset="cifar100") is not None
+    assert probe(dataset="cinic10") is not None
+    assert probe(data_augmentation=0) is None         # ablation off
+    assert probe(dataset="mnist", model="lr") is None  # non-cifar: off
+
+
 @pytest.mark.parametrize("model,dataset", BENCHMARK_PAIRS_LIGHT)
 def test_benchmark_matrix(model, dataset):
     out = run_experiment(_matrix_cfg(model, dataset), log_fn=None)

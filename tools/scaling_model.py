@@ -69,10 +69,12 @@ def sensitivity_bounds(t_compute: float, v_bytes: int,
     against any deployment, instead of on an uncited point value."""
     budget = t_compute * (1.0 - target_eff) / target_eff  # max t_allreduce
     n = 1024
-    # bandwidth break-even at negligible latency
-    bw_min = 2.0 * v_bytes * (n - 1) / n / budget
-    # latency break-even at infinite bandwidth (2(N-1) sequential hops)
-    lat_max = budget / (2.0 * (n - 1))
+    # each break-even holds the OTHER constant at its assumed value
+    # (the docstring's method, verbatim)
+    bw_min = (2.0 * v_bytes * (n - 1) / n
+              / (budget - 2.0 * (n - 1) * HOP_LATENCY))
+    lat_max = (budget - 2.0 * v_bytes * (n - 1) / n / V5E_DCN_BW) \
+        / (2.0 * (n - 1))
     return {
         "claim_holds_if": {
             "dcn_bandwidth_at_least_bytes_per_s": float(f"{bw_min:.3g}"),
@@ -92,7 +94,6 @@ def sensitivity_bounds(t_compute: float, v_bytes: int,
 
 def payload_bytes():
     import jax
-    import numpy as np
 
     from fedml_tpu.models.resnet import resnet56
 

@@ -19,7 +19,10 @@ from typing import Dict, Optional
 import numpy as np
 
 from fedml_tpu.core.types import FedDataset
-from fedml_tpu.data.synthetic import synthetic_classification
+from fedml_tpu.data.synthetic import (
+    match_pixel_scale,
+    synthetic_classification,
+)
 
 
 def _load_h5_clients(path: str, x_key: str, y_key: str):
@@ -86,7 +89,10 @@ def load_femnist(
     ds.train_client_idx = {
         c: idx[:cap] for c, idx in ds.train_client_idx.items()
     }
-    return ds
+    # real EMNIST pixel scale (published mean .1736 / std .3317 ⇒
+    # E[x²] ≈ .140) so the reference row's lr=.1 transfers — see
+    # synthetic.match_pixel_scale for the measured rationale
+    return match_pixel_scale(ds, 0.1736**2 + 0.3317**2)
 
 
 def load_fed_cifar100(

@@ -78,6 +78,28 @@ def synthetic_alpha_beta(
     )
 
 
+def match_pixel_scale(ds: FedDataset, target_second_moment: float) -> FedDataset:
+    """Rescale a stand-in's features to a real dataset's pixel scale.
+
+    The generator emits prototype+noise features with per-pixel second
+    moment ≈ 1+σ² (‖x‖ ≈ 36 for 784 dims at σ=0.8), while real pixel
+    datasets live in [0, 1] (MNIST: mean .1307, std .3081 ⇒ E[x²] ≈
+    .112, ‖x‖ ≈ 9.4).  Gradients of the first linear/conv layer scale
+    with ‖x‖², so reference learning rates tuned on real pixels are
+    effectively ~16× too hot on the raw stand-in — measured on the real
+    chip: MNIST-LR at the reference lr=.03 oscillates in a .41–.56 band
+    for 400 rounds and never converges (CONVERGENCE_r04 negative
+    artifact).  Multiplying BOTH signal and noise by one constant leaves
+    the task's Bayes error and the label-noise ceiling untouched; only
+    the gradient scale changes to match what the reference lr was tuned
+    for."""
+    cur = float(np.mean(np.square(ds.train_x), dtype=np.float64))
+    s = np.float32(np.sqrt(target_second_moment / cur))
+    ds.train_x = ds.train_x * s
+    ds.test_x = ds.test_x * s
+    return ds
+
+
 def _gaussian_blur_hw(a: np.ndarray, sigma: float) -> np.ndarray:
     """Separable Gaussian blur over the H, W axes of [..., H, W, C]
     (reflect padding), in plain numpy — no scipy dependency."""

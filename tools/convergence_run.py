@@ -535,8 +535,12 @@ def run_sampled_preset(args, spec):
     start_round = 0
     if getattr(args, "checkpoint_dir", ""):
         ckdir = os.path.join(args.checkpoint_dir, tag)
+        # standin_rev 2 = pixel-scale-matched features
+        # (synthetic.match_pixel_scale): a rev-1 checkpoint trained on
+        # 16×-hotter gradients must never resume into a rescaled run
         stamp = {"label_noise": args.label_noise, "rounds": args.rounds,
-                 "epochs": cfg.epochs, "lr": cfg.lr, "seed": 0}
+                 "epochs": cfg.epochs, "lr": cfg.lr, "seed": 0,
+                 "standin_rev": 2}
         stamp_path = os.path.join(ckdir, "config_stamp.json")
         os.makedirs(ckdir, exist_ok=True)
         if os.path.exists(stamp_path):
@@ -568,7 +572,8 @@ def run_sampled_preset(args, spec):
     # WHOLE run, not just the surviving session (advisor: a target first
     # crossed before the crash must not be reported as later/None)
     stamp_for_partial = {"label_noise": args.label_noise,
-                         "rounds": args.rounds, "lr": cfg.lr, "seed": 0}
+                         "rounds": args.rounds, "lr": cfg.lr, "seed": 0,
+                         "standin_rev": 2}
     prior_traj: list = []
     prior_wall = 0.0
     if start_round and os.path.exists(out + ".partial"):

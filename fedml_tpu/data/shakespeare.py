@@ -78,15 +78,37 @@ def _from_leaf_json(train_path: str, test_path: str) -> FedDataset:
 
 
 def _synthetic_text(num_clients: int, windows_per_client: int, seq: bool,
-                    seed: int, name: str) -> FedDataset:
+                    seed: int, name: str,
+                    peak_eta: Optional[float] = None,
+                    test_windows: Optional[int] = None) -> FedDataset:
     rng = np.random.RandomState(seed)
-    # Markov-ish synthetic text: random walk over the vocab keeps
-    # next-char structure learnable, unlike iid noise
-    def sample(n):
-        steps = rng.randint(-3, 4, size=n)
-        ids = np.clip(np.cumsum(steps) % (VOCAB_SIZE - 4), 0,
-                      VOCAB_SIZE - 5) + 1
-        return ids.astype(np.int32)
+    nchars = VOCAB_SIZE - 4  # the real char ids 1..86 (pad/OOV/BOS/EOS out)
+    if peak_eta is not None:
+        # Peaked first-order Markov chain for CONVERGENCE evidence: with
+        # prob 1-η the next char is a fixed random permutation σ(prev),
+        # else uniform over the vocab.  Bayes-optimal next-char accuracy
+        # is exactly (1-η) + η/nchars — the same documented-ceiling
+        # methodology as the label-noise image stand-ins
+        # (data/synthetic.py), for a sequence task where "flip the
+        # label" has no direct analogue.
+        perm = rng.permutation(nchars)
+
+        def sample(n):
+            ids = np.empty(n, np.int64)
+            ids[0] = rng.randint(nchars)
+            jump = rng.rand(n) < peak_eta
+            unif = rng.randint(0, nchars, size=n)
+            for i in range(1, n):
+                ids[i] = unif[i] if jump[i] else perm[ids[i - 1]]
+            return (ids + 1).astype(np.int32)
+    else:
+        # Markov-ish synthetic text: random walk over the vocab keeps
+        # next-char structure learnable, unlike iid noise
+        def sample(n):
+            steps = rng.randint(-3, 4, size=n)
+            ids = np.clip(np.cumsum(steps) % nchars, 0,
+                          nchars - 1) + 1
+            return ids.astype(np.int32)
 
     def block(n_windows):
         text = sample(n_windows * SEQ_LEN + 1)
@@ -98,15 +120,28 @@ def _synthetic_text(num_clients: int, windows_per_client: int, seq: bool,
             y = np.asarray([w[-1] for w in ys], np.int32)
         return x, y
 
+    if peak_eta is not None:
+        # LEAF's realistic partition is heterogeneous in SHARD SIZE
+        # (roles speak wildly different amounts of text); mirror that
+        # with lognormal window counts clipped to [4, windows_per_client]
+        # — the distributional signal itself stays one shared chain
+        # (documented as iid across clients in the convergence artifact)
+        sizes = np.clip(
+            np.exp(rng.normal(np.log(max(windows_per_client // 3, 4)),
+                              0.8, num_clients)),
+            4, windows_per_client).astype(int)
+    else:
+        sizes = np.full(num_clients, windows_per_client)
     xs, ys, idx = [], [], {}
     off = 0
     for c in range(num_clients):
-        x, y = block(windows_per_client)
+        x, y = block(int(sizes[c]))
         xs.append(x)
         ys.append(y)
         idx[c] = np.arange(off, off + len(y))
         off += len(y)
-    tx, t_y = block(max(windows_per_client, 8))
+    tx, t_y = block(test_windows if test_windows is not None
+                    else max(windows_per_client, 8))
     return FedDataset(
         train_x=np.concatenate(xs), train_y=np.concatenate(ys),
         test_x=tx, test_y=t_y, train_client_idx=idx, test_client_idx=None,
@@ -119,8 +154,17 @@ def load_shakespeare(
     num_clients: int = 10,
     windows_per_client: int = 16,
     seed: int = 0,
+    standin_peak_eta: Optional[float] = None,
+    standin_test_windows: Optional[int] = None,
 ) -> FedDataset:
-    """LEAF variant: y = one next char per window."""
+    """LEAF variant: y = one next char per window.
+
+    ``standin_peak_eta`` / ``standin_test_windows`` apply ONLY to the
+    offline synthetic stand-in: the former switches the random-walk
+    text to the peaked Markov chain with a documented Bayes ceiling
+    (see ``_synthetic_text``), the latter sizes the held-out window set
+    (convergence evidence needs more than the default handful); real
+    LEAF json is never modified."""
     tr = os.path.join(data_dir, "train")
     te = os.path.join(data_dir, "test")
     if os.path.isdir(tr) and os.path.isdir(te):
@@ -131,7 +175,9 @@ def load_shakespeare(
         if trj and tej:
             return _from_leaf_json(trj[0], tej[0])
     return _synthetic_text(num_clients, windows_per_client, seq=False,
-                           seed=seed, name="shakespeare(synthetic-standin)")
+                           seed=seed, name="shakespeare(synthetic-standin)",
+                           peak_eta=standin_peak_eta,
+                           test_windows=standin_test_windows)
 
 
 def load_fed_shakespeare(

@@ -159,8 +159,11 @@ def test_standin_pixel_scale_matches_real_dataset():
     target = 0.1307**2 + 0.3081**2
     got = float((ds.train_x.astype(np.float64) ** 2).mean())
     assert abs(got - target) / target < 1e-4
+    # FEMNIST: the reference trains on raw TFF h5 pixels (white-
+    # background, x = 1 - ink), so the target is E[(1-z)^2] with the
+    # published EMNIST ink stats — see data/emnist.py
     fem = load_femnist(data_dir="/nonexistent", num_clients=20)
-    t2 = 0.1736**2 + 0.3317**2
+    t2 = 1.0 - 2 * 0.1736 + 0.1736**2 + 0.3317**2
     g2 = float((fem.train_x.astype(np.float64) ** 2).mean())
     assert abs(g2 - t2) / t2 < 1e-4
     # the rescale is a single global scalar applied AFTER generation:
@@ -177,3 +180,36 @@ def test_standin_pixel_scale_matches_real_dataset():
     ratio = flat[nz] / unscaled.train_x.reshape(len(flat), -1)[nz]
     assert float(ratio.std()) < 1e-4  # direction/labels untouched
     assert np.array_equal(ds.train_y, unscaled.train_y)
+
+
+def test_shakespeare_peaked_chain_ceiling():
+    """The convergence stand-in's peaked Markov chain has a DOCUMENTED
+    Bayes next-char ceiling (1-eta) + eta/86: an oracle that knows the
+    permutation and always predicts sigma(prev) scores exactly the
+    chain's peak probability in expectation.  Also: the default
+    (random-walk) stand-in is byte-identical to before the knob."""
+    from fedml_tpu.data.shakespeare import load_shakespeare
+
+    eta = 0.2
+    ds = load_shakespeare(data_dir="/nonexistent", num_clients=4,
+                          windows_per_client=8, standin_peak_eta=eta,
+                          standin_test_windows=500)
+    assert ds.test_x.shape == (500, 80)
+    # oracle accuracy over consecutive in-window pairs: build sigma from
+    # observed majority transitions, then score it on the test windows
+    x = ds.test_x - 1
+    prev, nxt = x[:, :-1].ravel(), x[:, 1:].ravel()
+    sigma = np.full(86, -1)
+    for p in range(86):
+        outs = nxt[prev == p]
+        if len(outs):
+            sigma[p] = np.bincount(outs, minlength=86).argmax()
+    oracle_acc = float((sigma[prev] == nxt).mean())
+    ceiling = (1 - eta) + eta / (VOCAB_SIZE - 4)
+    assert abs(oracle_acc - ceiling) < 0.02
+    # default stand-in unchanged by the new kwargs
+    a = load_shakespeare(data_dir="/nonexistent", num_clients=2,
+                         windows_per_client=4)
+    b = load_shakespeare(data_dir="/nonexistent", num_clients=2,
+                         windows_per_client=4)
+    assert np.array_equal(a.train_x, b.train_x)

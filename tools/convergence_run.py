@@ -306,11 +306,13 @@ def run_northstar_once(partition, args, log_prefix):
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset",
-                   choices=["northstar", "mnist_lr", "femnist_cnn"],
+                   choices=["northstar", "mnist_lr", "femnist_cnn",
+                            "shakespeare_rnn"],
                    default="northstar")
     p.add_argument("--rounds", type=int, default=None,
                    help="horizon (default: northstar 100, mnist_lr 400, "
-                   "femnist_cnn 1500 — the reference rows' scales)")
+                   "femnist_cnn 1500, shakespeare_rnn 1200 — the "
+                   "reference rows' scales)")
     p.add_argument("--num-train", type=int, default=None)
     p.add_argument("--num-test", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
@@ -362,10 +364,11 @@ def main():
 
     if args.rounds is None:
         args.rounds = {"northstar": 100, "mnist_lr": 400,
-                       "femnist_cnn": 1500}[args.preset]
+                       "femnist_cnn": 1500,
+                       "shakespeare_rnn": 1200}[args.preset]
     if args.eval_every is None:
         args.eval_every = 5 if args.preset == "northstar" else 25
-    if args.preset in ("mnist_lr", "femnist_cnn"):
+    if args.preset in ("mnist_lr", "femnist_cnn", "shakespeare_rnn"):
         run_cross_device(args)
         return
 
@@ -439,8 +442,9 @@ def run_cross_device(args):
             "--num-train/--num-test apply to the northstar preset only "
             "(the cross-device presets follow the reference's sizing)"
         )
-    spec = (_mnist_lr_spec if args.preset == "mnist_lr"
-            else _femnist_cnn_spec)(args)
+    spec = {"mnist_lr": _mnist_lr_spec,
+            "femnist_cnn": _femnist_cnn_spec,
+            "shakespeare_rnn": _shakespeare_rnn_spec}[args.preset](args)
     run_sampled_preset(args, spec)
 
 
@@ -514,6 +518,61 @@ def _femnist_cnn_spec(args):
     }
 
 
+def _shakespeare_rnn_spec(args):
+    """Reference row ``benchmark/README.md:56``: Shakespeare (LEAF
+    realistic partition) + RNN (2 LSTM + 1 FC), 715 clients, 10/round,
+    SGD lr 1.0, E=1, batch 4, 56.9 @ >1200 rounds.  The stand-in is the
+    peaked Markov chain (``data/shakespeare.py _synthetic_text``):
+    --label-noise is reused as the chain's jump rate η, giving the
+    documented Bayes next-char ceiling (1-η) + η/86."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.data.shakespeare import VOCAB_SIZE, load_shakespeare
+    from fedml_tpu.models.rnn import rnn_shakespeare
+
+    ds = load_shakespeare(num_clients=715, windows_per_client=64,
+                          standin_peak_eta=args.label_noise,
+                          standin_test_windows=2000)
+    cfg = FedAvgConfig(
+        # real LEAF json ignores the stand-in kwargs and brings its own
+        # user count — cfg must follow the DATASET or cohort sampling
+        # would draw client ids the partition doesn't hold
+        num_clients=ds.num_clients, clients_per_round=10,
+        comm_rounds=args.rounds,
+        epochs=1 if args.epochs is None else args.epochs, batch_size=4,
+        client_optimizer="sgd", lr=1.0,
+        frequency_of_the_test=args.eval_every, seed=0,
+    )
+    eta = args.label_noise
+    return {
+        "tag": "shakespeare_rnn",
+        "out": "CONVERGENCE_r04_shakespeare_rnn.json",
+        "cfg": cfg,
+        "ds": ds,
+        "bundle": rnn_shakespeare(),
+        "model_desc": "rnn_shakespeare (embed 8 + 2xLSTM(256) + FC, "
+                      "90-symbol vocab)",
+        "experiment": ("cross-device convergence "
+                       "(peaked-Markov Shakespeare stand-in, 715 clients)"),
+        "reference_target": {
+            "dataset": "Shakespeare LEAF (real, unavailable offline)",
+            "acc": "56.9", "rounds": ">1200",
+            "source": "/root/reference/benchmark/README.md:56",
+        },
+        # 56.9 on real Shakespeare (~1.0-style ceiling-relative analogue)
+        "target_frac": 0.569,
+        # honest stand-in description: shard SIZES are heterogeneous
+        # (lognormal, mirroring LEAF), the text DISTRIBUTION is one
+        # shared chain — iid across clients, unlike real LEAF roles
+        "partition": "lognormal shard sizes, iid shared-chain text "
+                     "(stand-in; no distributional heterogeneity)",
+        # Bayes next-char accuracy of the peaked chain, NOT 1-eta
+        "ceiling": (1.0 - eta) + eta / (VOCAB_SIZE - 4),
+        # the --label-noise flag is the chain's JUMP RATE here (no
+        # labels are flipped); record it under an accurate key
+        "hardness_knob": "standin_markov_jump_eta",
+    }
+
+
 def run_sampled_preset(args, spec):
     """Shared driver for the sampled-cohort (cross-device) benchmark
     rows: ``run_fused_sampled`` fast path (the host pre-draws each
@@ -526,7 +585,8 @@ def run_sampled_preset(args, spec):
 
     tag, cfg, ds = spec["tag"], spec["cfg"], spec["ds"]
     out = args.out or spec["out"]
-    target = spec["target_frac"] * (1.0 - args.label_noise)
+    ceiling = spec.get("ceiling", 1.0 - args.label_noise)
+    target = spec["target_frac"] * ceiling
     sim = FedAvgSimulation(spec["bundle"], ds, cfg)
 
     # checkpoint/resume mirrors the north-star preset: multi-hundred-
@@ -623,8 +683,9 @@ def run_sampled_preset(args, spec):
         # the loaders never modify real on-disk data, so claiming an
         # irreducible-error ceiling there would misdescribe the run
         **({"hardness": {
-                "standin_label_noise": args.label_noise,
-                "accuracy_ceiling": 1.0 - args.label_noise,
+                spec.get("hardness_knob",
+                         "standin_label_noise"): args.label_noise,
+                "accuracy_ceiling": round(ceiling, 4),
                 # reference accuracy is on a ~1.0-ceiling real dataset:
                 # the ceiling-relative analogue, pre-declared
                 "target_for_rounds_to_target": round(target, 4)}}
@@ -633,7 +694,8 @@ def run_sampled_preset(args, spec):
             "model": spec["model_desc"],
             "clients": cfg.num_clients,
             "clients_per_round": cfg.clients_per_round,
-            "partition": "power_law", "optimizer": "sgd", "lr": cfg.lr,
+            "partition": spec.get("partition", "power_law"),
+            "optimizer": "sgd", "lr": cfg.lr,
             "local_epochs": cfg.epochs, "batch_size": cfg.batch_size,
             "rounds": args.rounds,
             "driver": ("run_fused_sampled (scheduled cohorts, "

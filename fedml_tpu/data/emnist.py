@@ -105,7 +105,20 @@ def load_femnist(
 def load_fed_cifar100(
     data_dir: str = "./data/fed_cifar100/datasets",
     seed: int = 0,
+    num_clients: int = 50,
+    standin_label_noise: float = 0.0,
+    standin_natural_stats: bool = False,
 ) -> FedDataset:
+    """``num_clients`` / ``standin_label_noise`` shape ONLY the offline
+    synthetic stand-in (TFF fed-CIFAR100 brings its own natural
+    500-client partition of ~100 samples each); real h5 data is never
+    modified.  The stand-in's unit-variance features already match the
+    reference's normalized pixels (``fed_cifar100/utils.py:16``
+    Normalize(mean, std) ⇒ E[x²] ≈ 1) — no pixel-scale correction.
+    ``standin_natural_stats`` gives the prototypes the smooth /
+    flip-symmetric statistics that keep the reference's crop+flip train
+    transform (``utils.py:13-16``) label-preserving, as for the
+    CIFAR-10 stand-in."""
     tr = os.path.join(data_dir, "fed_cifar100_train.h5")
     te = os.path.join(data_dir, "fed_cifar100_test.h5")
     if os.path.exists(tr) and os.path.exists(te):
@@ -118,7 +131,11 @@ def load_fed_cifar100(
             num_classes=100, name="fed_cifar100",
         )
     return synthetic_classification(
-        num_train=50 * 100, num_test=50 * 20, input_shape=(24, 24, 3),
-        num_classes=100, num_clients=50, partition="homo", seed=seed,
+        num_train=num_clients * 100, num_test=min(num_clients * 20, 10000),
+        input_shape=(24, 24, 3),
+        num_classes=100, num_clients=num_clients, partition="homo",
+        seed=seed, label_noise=standin_label_noise,
+        smooth_sigma=2.0 if standin_natural_stats else 0.0,
+        flip_symmetric=standin_natural_stats,
         name="fed_cifar100(synthetic-standin)",
     )

@@ -94,12 +94,26 @@ def _synthetic_text(num_clients: int, windows_per_client: int, seq: bool,
         perm = rng.permutation(nchars)
 
         def sample(n):
-            ids = np.empty(n, np.int64)
-            ids[0] = rng.randint(nchars)
+            # vectorized over jump segments: between jumps the chain is
+            # deterministic (ids[s+k] = perm^k(ids[s])), so build a
+            # perm-power table up to the longest segment and index it —
+            # same RNG stream (and bit-identical output) as the naive
+            # per-char loop
+            first = rng.randint(nchars)
             jump = rng.rand(n) < peak_eta
             unif = rng.randint(0, nchars, size=n)
-            for i in range(1, n):
-                ids[i] = unif[i] if jump[i] else perm[ids[i - 1]]
+            starts = np.concatenate(
+                [[0], np.flatnonzero(jump[1:]) + 1])
+            start_val = np.concatenate([[first], unif[starts[1:]]])
+            seg = np.zeros(n, np.int64)
+            seg[starts[1:]] = 1
+            seg = np.cumsum(seg)
+            k = np.arange(n) - starts[seg]
+            ptab = np.empty((int(k.max()) + 1, nchars), np.int64)
+            ptab[0] = np.arange(nchars)
+            for t in range(1, len(ptab)):
+                ptab[t] = perm[ptab[t - 1]]
+            ids = ptab[k, start_val[seg]]
             return (ids + 1).astype(np.int32)
     else:
         # Markov-ish synthetic text: random walk over the vocab keeps

@@ -307,11 +307,12 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset",
                    choices=["northstar", "mnist_lr", "femnist_cnn",
-                            "shakespeare_rnn"],
+                            "shakespeare_rnn", "fed_cifar100"],
                    default="northstar")
     p.add_argument("--rounds", type=int, default=None,
                    help="horizon (default: northstar 100, mnist_lr 400, "
-                   "femnist_cnn 1500, shakespeare_rnn 1200 — the "
+                   "femnist_cnn 1500, shakespeare_rnn 1200, fed_cifar100 "
+                   "600 [truncated vs the reference's 4000] — the "
                    "reference rows' scales)")
     p.add_argument("--num-train", type=int, default=None)
     p.add_argument("--num-test", type=int, default=None)
@@ -365,10 +366,12 @@ def main():
     if args.rounds is None:
         args.rounds = {"northstar": 100, "mnist_lr": 400,
                        "femnist_cnn": 1500,
-                       "shakespeare_rnn": 1200}[args.preset]
+                       "shakespeare_rnn": 1200,
+                       "fed_cifar100": 600}[args.preset]
     if args.eval_every is None:
         args.eval_every = 5 if args.preset == "northstar" else 25
-    if args.preset in ("mnist_lr", "femnist_cnn", "shakespeare_rnn"):
+    if args.preset in ("mnist_lr", "femnist_cnn", "shakespeare_rnn",
+                       "fed_cifar100"):
         run_cross_device(args)
         return
 
@@ -444,7 +447,8 @@ def run_cross_device(args):
         )
     spec = {"mnist_lr": _mnist_lr_spec,
             "femnist_cnn": _femnist_cnn_spec,
-            "shakespeare_rnn": _shakespeare_rnn_spec}[args.preset](args)
+            "shakespeare_rnn": _shakespeare_rnn_spec,
+            "fed_cifar100": _fed_cifar100_spec}[args.preset](args)
     run_sampled_preset(args, spec)
 
 
@@ -573,6 +577,68 @@ def _shakespeare_rnn_spec(args):
     }
 
 
+def _fed_cifar100_spec(args):
+    """Reference row ``benchmark/README.md:55``: fed_CIFAR100 (TFF
+    natural 500-client partition) + ResNet-18-GN, 10/round, SGD lr 0.1,
+    E=1, batch 20, 44.7 @ >4000 rounds.  The reference trains on
+    normalized 24×24 crops with crop+flip
+    (``fed_cifar100/utils.py:8-26``); the stand-in's unit-variance
+    features already sit at that scale, and the preset trains with the
+    same crop+flip (no cutout — the reference recipe has none here).
+    The default horizon is 600 rounds — 4000 is declared out of budget
+    up front and the artifact records the reference's full-horizon row
+    verbatim, so a sub-target finish at 600 reads as 'trajectory
+    rising, horizon truncated', not a miss."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.data.augment import make_image_augment
+    from fedml_tpu.data.emnist import load_fed_cifar100
+    from fedml_tpu.models.resnet_gn import resnet18_gn
+
+    ds = load_fed_cifar100(num_clients=500,
+                           standin_label_noise=args.label_noise,
+                           standin_natural_stats=True)
+    if "standin" not in ds.name:
+        # the real TFF h5 path returns raw 32×32 /255 images; the
+        # reference recipe (32→24 crop + Normalize, utils.py:8-26) is
+        # applied by the experiments dispatcher, not this preset —
+        # training resnet18_gn(24) on un-normalized 32×32 would neither
+        # run nor mean anything
+        raise SystemExit(
+            "real fed_cifar100 h5 detected: this convergence preset "
+            "targets the offline stand-in; run the real dataset via "
+            "experiments/run.py --dataset fed_cifar100 instead")
+    cfg = FedAvgConfig(
+        num_clients=ds.num_clients, clients_per_round=10,
+        comm_rounds=args.rounds,
+        epochs=1 if args.epochs is None else args.epochs, batch_size=20,
+        client_optimizer="sgd", lr=0.1,
+        frequency_of_the_test=args.eval_every, compute_dtype="bf16",
+        seed=0,
+    )
+    return {
+        "tag": "fed_cifar100",
+        "out": "CONVERGENCE_r04_fed_cifar100.json",
+        "cfg": cfg,
+        "ds": ds,
+        "bundle": resnet18_gn(num_classes=100, image_size=24),
+        "model_desc": "ResNet-18-GN (GroupNorm, 24x24 input)",
+        "experiment": ("cross-device convergence "
+                       "(synthetic fed-CIFAR100 stand-in, 500 clients)"),
+        "reference_target": {
+            "dataset": "fed_CIFAR100 TFF h5 (real, unavailable offline)",
+            "acc": "44.7", "rounds": ">4000",
+            "source": "/root/reference/benchmark/README.md:55",
+        },
+        "target_frac": 0.447,
+        "partition": "homo, 100 samples/client (TFF natural-partition "
+                     "analogue)",
+        # reference recipe: RandomCrop(24, pad implied by 32->24 crop)
+        # + flip + Normalize; the stand-in is generated at 24x24, so
+        # crop uses the same pad-4 shift convention as cifar_augment
+        "augment_fn": make_image_augment(pad=4, flip=True, cutout=None),
+    }
+
+
 def run_sampled_preset(args, spec):
     """Shared driver for the sampled-cohort (cross-device) benchmark
     rows: ``run_fused_sampled`` fast path (the host pre-draws each
@@ -587,7 +653,8 @@ def run_sampled_preset(args, spec):
     out = args.out or spec["out"]
     ceiling = spec.get("ceiling", 1.0 - args.label_noise)
     target = spec["target_frac"] * ceiling
-    sim = FedAvgSimulation(spec["bundle"], ds, cfg)
+    sim = FedAvgSimulation(spec["bundle"], ds, cfg,
+                           augment_fn=spec.get("augment_fn"))
 
     # checkpoint/resume mirrors the north-star preset: multi-hundred-
     # round horizons outlive the tunnel's session stability
@@ -595,12 +662,15 @@ def run_sampled_preset(args, spec):
     start_round = 0
     if getattr(args, "checkpoint_dir", ""):
         ckdir = os.path.join(args.checkpoint_dir, tag)
-        # standin_rev 2 = pixel-scale-matched features
-        # (synthetic.match_pixel_scale): a rev-1 checkpoint trained on
-        # 16×-hotter gradients must never resume into a rescaled run
+        # standin_rev chronicles stand-in DATA changes a same-shape
+        # checkpoint can't detect: 2 = pixel-scale-matched features
+        # (synthetic.match_pixel_scale), 3 = FEMNIST target corrected
+        # to the raw TFF white-background scale (E[x²] .14 → .79).  A
+        # checkpoint trained on differently-scaled gradients must never
+        # resume into a rescaled run.
         stamp = {"label_noise": args.label_noise, "rounds": args.rounds,
                  "epochs": cfg.epochs, "lr": cfg.lr, "seed": 0,
-                 "standin_rev": 2}
+                 "standin_rev": 3}
         stamp_path = os.path.join(ckdir, "config_stamp.json")
         os.makedirs(ckdir, exist_ok=True)
         if os.path.exists(stamp_path):
@@ -633,7 +703,7 @@ def run_sampled_preset(args, spec):
     # crossed before the crash must not be reported as later/None)
     stamp_for_partial = {"label_noise": args.label_noise,
                          "rounds": args.rounds, "lr": cfg.lr, "seed": 0,
-                         "standin_rev": 2}
+                         "standin_rev": 3}
     prior_traj: list = []
     prior_wall = 0.0
     if start_round and os.path.exists(out + ".partial"):

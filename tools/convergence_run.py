@@ -37,8 +37,9 @@ cross-DEVICE benchmark row (``benchmark/README.md:12``: MNIST + LR,
 — on the per-round driver (sampling 10/1000 on a resident 1000-client
 block would waste 100× the compute).
 
-Usage: python tools/convergence_run.py [--preset northstar|mnist_lr]
-       [--rounds 100] [--partitions both|iid|noniid] [--out FILE]
+Usage: python tools/convergence_run.py
+       [--preset northstar|mnist_lr|femnist_cnn|shakespeare_rnn|fed_cifar100]
+       [--rounds N] [--partitions both|iid|noniid] [--out FILE]
 """
 
 from __future__ import annotations

@@ -24,7 +24,7 @@ import numpy as np
 from fedml_tpu.core.partition import partition_data
 from fedml_tpu.core.types import FedDataset
 from fedml_tpu.data.synthetic import (
-    match_pixel_scale,
+    match_pixel_moments,
     synthetic_classification,
 )
 
@@ -175,10 +175,10 @@ def load_mnist(
             ds.train_client_idx = {
                 c: idx[:cap] for c, idx in ds.train_client_idx.items()
             }
-        # real MNIST pixel scale (mean .1307 / std .3081, the published
-        # torchvision normalization constants ⇒ E[x²] ≈ .112) so the
-        # reference row's lr transfers — see match_pixel_scale
-        ds = match_pixel_scale(ds, 0.1307**2 + 0.3081**2)
+        # real MNIST pixel moments (mean .1307 / std .3081, the
+        # published torchvision normalization constants) so the
+        # reference row's lr transfers — see match_pixel_moments
+        ds = match_pixel_moments(ds, 0.1307, 0.3081)
         if flatten:
             ds.train_x = ds.train_x.reshape(len(ds.train_x), -1)
             ds.test_x = ds.test_x.reshape(len(ds.test_x), -1)

@@ -78,25 +78,34 @@ def synthetic_alpha_beta(
     )
 
 
-def match_pixel_scale(ds: FedDataset, target_second_moment: float) -> FedDataset:
-    """Rescale a stand-in's features to a real dataset's pixel scale.
+def match_pixel_moments(ds: FedDataset, mean: float, std: float) -> FedDataset:
+    """Affinely map a stand-in's features to a real dataset's pixel
+    mean AND std (one global scalar + offset on signal and noise alike,
+    so the task's Bayes error and the label-noise ceiling are
+    untouched).
 
-    The generator emits prototype+noise features with per-pixel second
-    moment ≈ 1+σ² (‖x‖ ≈ 36 for 784 dims at σ=0.8), while real pixel
-    datasets live in [0, 1] (MNIST: mean .1307, std .3081 ⇒ E[x²] ≈
-    .112, ‖x‖ ≈ 9.4).  Gradients of the first linear/conv layer scale
-    with ‖x‖², so reference learning rates tuned on real pixels are
-    effectively ~16× too hot on the raw stand-in — measured on the real
-    chip: MNIST-LR at the reference lr=.03 oscillates in a .41–.56 band
-    for 400 rounds and never converges (CONVERGENCE_r04 negative
-    artifact).  Multiplying BOTH signal and noise by one constant leaves
-    the task's Bayes error and the label-noise ceiling untouched; only
-    the gradient scale changes to match what the reference lr was tuned
-    for."""
-    cur = float(np.mean(np.square(ds.train_x), dtype=np.float64))
-    s = np.float32(np.sqrt(target_second_moment / cur))
-    ds.train_x = ds.train_x * s
-    ds.test_x = ds.test_x * s
+    Why both moments matter — two measured failures on the real chip:
+
+    - **Scale**: the raw generator emits per-pixel second moment
+      ≈ 1+σ² (‖x‖ ≈ 36 for 784 dims) vs real MNIST's [0,1] pixels at
+      E[x²] ≈ .112 (‖x‖ ≈ 9.4).  First-layer gradients scale with
+      ‖x‖², so the reference MNIST-LR lr=.03 ran ~16× hot and
+      oscillated in a .41–.56 band for 400 rounds
+      (CONVERGENCE_r04_mnist_lr_unscaled_negative.json).
+    - **Placement**: matching the second moment ALONE mis-places it for
+      white-background datasets.  TFF FEMNIST pixels (x = 1-ink) have
+      E[x²] ≈ .79, but ~86% of that is a DC mean (.826²) and only .11
+      is variance; a zero-mean stand-in carrying the whole .79 as
+      VARIANCE feeds ~7× the real per-pixel signal power into the
+      first conv layer — the reference lr=.1 NaN'd within 75 rounds
+      (r4, femnist_cnn first attempt).  Matching mean and std puts the
+      DC where the real data has it."""
+    cur_mean = float(np.mean(ds.train_x, dtype=np.float64))
+    cur_std = float(np.std(ds.train_x, dtype=np.float64))
+    s = np.float32(std / cur_std)
+    off = np.float32(mean - cur_mean * (std / cur_std))
+    ds.train_x = ds.train_x * s + off
+    ds.test_x = ds.test_x * s + off
     return ds
 
 

@@ -37,6 +37,14 @@ import numpy as np
 
 REFERENCE_GPU_SAMPLES_PER_SEC = 1500.0
 
+# workload-aware rounds-per-call defaults (single source of truth for
+# the CLI and the build_* signatures): north_star 80 ~= 43 s/call,
+# fedllm 4 ~= 11 s/call -- both under the axon tunnel's ~70 s
+# single-execution deadline (80 on fedllm = ~220 s/call, measured
+# worker kill)
+NORTH_STAR_RPC = 80
+FEDLLM_RPC = 4
+
 
 def build_north_star(
     clients: int = 10,
@@ -45,7 +53,7 @@ def build_north_star(
     epochs: int = 1,
     dtype: str = "bf16",
     unroll: int = 4,
-    rounds_per_call: int = 80,
+    rounds_per_call: int = NORTH_STAR_RPC,
     client_unroll: int = 1,
 ):
     """The canonical bench workload, shared with tools/scaling_model.py
@@ -106,7 +114,7 @@ def build_fedllm(
     epochs: int = 1,
     dtype: str = "bf16",
     unroll: int = 1,
-    rounds_per_call: int = 1,
+    rounds_per_call: int = FEDLLM_RPC,
 ):
     """MXU-friendly federated-LLM workload (the ``fedllm`` experiment
     family): next-token training of a GPT-2-shaped decoder (default
@@ -187,13 +195,15 @@ def main():
     p.add_argument("--rounds", type=int, default=4,
                    help="measured multi-round calls (median over these)")
     p.add_argument(
-        "--rounds-per-call", type=int, default=80,
+        "--rounds-per-call", type=int, default=None,
         help="federated rounds fused per compiled call "
-        "(make_multi_round_fn); 1 = per-round dispatch path. Measured "
-        "ladder on v5e (PROFILE.md): 10=26.5k, 20=27.6k, 40=28.4k, "
-        "80=28.8k samples/s. 80 is the default (~43 s/call — still "
-        "under the axon tunnel's ~70 s single-execution deadline; on "
-        "direct-attached chips any value works)",
+        "(make_multi_round_fn); 1 = per-round dispatch path. Default "
+        "is workload-aware: north_star 80 (~43 s/call — measured "
+        "ladder on v5e, PROFILE.md: 10=26.5k, 20=27.6k, 40=28.4k, "
+        "80=28.8k samples/s), fedllm 4 (~11 s/call; 80 would be "
+        "~220 s/call at 48k tokens/s, past the axon tunnel's ~70 s "
+        "single-execution deadline — measured worker kill). On "
+        "direct-attached chips any value works",
     )
     p.add_argument(
         "--unroll", type=int, default=4,
@@ -223,8 +233,11 @@ def main():
     )
     p.add_argument("--seq-len", type=int, default=1024)
     p.add_argument("--embed-dim", type=int, default=1280,
-                   help="1280/h10 measured best on v5e (40.8% MFU); 1536 "
-                   "OOMs HBM at batch 8x1024 without remat")
+                   help="1280/h10 measured best on v5e (width sweep at "
+                   "rounds-per-call 1: 768=24.2%, 1024=37.7%, "
+                   "1280=40.8%; the rpc=4 default lifts 1280 to 47.3% "
+                   "by amortizing dispatch); 1536 OOMs HBM at batch "
+                   "8x1024 without remat")
     p.add_argument("--num-layers", type=int, default=12)
     p.add_argument("--num-heads", type=int, default=10)
     p.add_argument("--vocab", type=int, default=8192)
@@ -232,9 +245,11 @@ def main():
     # workload-aware defaults: the fedllm model is ~50x the FLOPs and
     # memory per sample of the ResNet workload, so sharing the
     # north-star cohort defaults would OOM the chip
-    wd = ({"clients": 10, "batch": 64, "steps": 24}
+    wd = ({"clients": 10, "batch": 64, "steps": 24,
+           "rounds_per_call": NORTH_STAR_RPC}
           if args.workload == "north_star"
-          else {"clients": 4, "batch": 8, "steps": 4})
+          else {"clients": 4, "batch": 8, "steps": 4,
+                "rounds_per_call": FEDLLM_RPC})
     for k, v in wd.items():
         if getattr(args, k) is None:
             setattr(args, k, v)
@@ -288,6 +303,9 @@ def main():
                             "clients": args.clients,
                             "batch": args.batch,
                             "steps": args.steps,
+                            "rounds_per_call": args.rounds_per_call,
+                            "epochs": args.epochs,
+                            "unroll": args.unroll,
                             "dtype": args.dtype,
                         },
                     },

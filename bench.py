@@ -235,7 +235,7 @@ def main():
     p.add_argument("--embed-dim", type=int, default=1280,
                    help="1280/h10 measured best on v5e (width sweep at "
                    "rounds-per-call 1: 768=24.2%, 1024=37.7%, "
-                   "1280=40.8%; the rpc=4 default lifts 1280 to 47.3% "
+                   "1280=40.8%; the rpc=4 default lifts 1280 to 47.5% "
                    "by amortizing dispatch); 1536 OOMs HBM at batch "
                    "8x1024 without remat")
     p.add_argument("--num-layers", type=int, default=12)

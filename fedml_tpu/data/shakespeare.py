@@ -97,8 +97,8 @@ def _synthetic_text(num_clients: int, windows_per_client: int, seq: bool,
             # vectorized over jump segments: between jumps the chain is
             # deterministic (ids[s+k] = perm^k(ids[s])), so build a
             # perm-power table up to the longest segment and index it —
-            # same RNG stream (and bit-identical output) as the naive
-            # per-char loop
+            # equivalent to walking the chain per character over the
+            # same pre-drawn jump/uniform arrays
             first = rng.randint(nchars)
             jump = rng.rand(n) < peak_eta
             unif = rng.randint(0, nchars, size=n)

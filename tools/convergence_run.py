@@ -245,24 +245,12 @@ def run_northstar_once(partition, args, log_prefix):
         # noise/seed/epochs — same pytree shapes, so the shape guard
         # can't catch it) must never be silently resumed into this run
         stamp = {"noise": args.noise, "label_noise": args.label_noise,
-                 "epochs": args.epochs, "rounds": args.rounds,
+                 "epochs": args.epochs,
                  "num_train": args.num_train, "seed": 0,
                  "augment": bool(args.augment),
                  "smooth_sigma": args.smooth_sigma,
                  "flip_symmetric": bool(args.flip_symmetric)}
-        stamp_path = os.path.join(ckdir, "config_stamp.json")
-        os.makedirs(ckdir, exist_ok=True)
-        if os.path.exists(stamp_path):
-            prior = json.load(open(stamp_path))
-            if prior != stamp:
-                raise SystemExit(
-                    f"checkpoint dir {ckdir} holds a run with a "
-                    f"different config ({prior} != {stamp}); pass "
-                    "--checkpoint-dir '' or remove the directory"
-                )
-        else:
-            with open(stamp_path, "w") as f:
-                json.dump(stamp, f)
+        check_config_stamp(ckdir, stamp)
         mgr = CheckpointManager(ckdir, max_to_keep=2)
         if mgr.latest_step() is not None:
             sim.state = mgr.restore(like=sim.state)
@@ -660,6 +648,41 @@ def _fed_cifar100_spec(args):
     }
 
 
+def check_config_stamp(ckdir: str, stamp: dict) -> None:
+    """One stamp policy for BOTH preset families: the stamp holds every
+    knob that changes the training dynamics a checkpoint encodes; the
+    horizon (``--rounds``) is deliberately NOT in it — per-round
+    randomness is ``fold_in``-keyed on the absolute round index, so a
+    state at round R is identical whether the run was launched with
+    ``--rounds 600`` or ``4000``, and extending a finished run to a
+    longer horizon (fed_cifar100 600→4000) is exactly the resume use
+    case.  Stamps written by the pre-r5 code carried a legacy
+    ``rounds`` key; those are accepted after dropping it (it never
+    affected dynamics) and the file is rewritten in the new format."""
+    stamp_path = os.path.join(ckdir, "config_stamp.json")
+    os.makedirs(ckdir, exist_ok=True)
+
+    def write_atomic():
+        # the tunnel wedging mid-session is this repo's normal failure
+        # mode — never truncate a good stamp in place
+        with open(stamp_path + ".tmp", "w") as f:
+            json.dump(stamp, f)
+        os.replace(stamp_path + ".tmp", stamp_path)
+
+    if os.path.exists(stamp_path):
+        prior = json.load(open(stamp_path))
+        legacy = prior.pop("rounds", None)
+        if prior != stamp:
+            raise SystemExit(
+                f"checkpoint dir {ckdir} holds a run with a different "
+                f"config ({prior} != {stamp}); pass --checkpoint-dir "
+                "'' or remove the directory")
+        if legacy is not None:
+            write_atomic()
+    else:
+        write_atomic()
+
+
 def run_sampled_preset(args, spec):
     """Shared driver for the sampled-cohort (cross-device) benchmark
     rows: ``run_fused_sampled`` fast path (the host pre-draws each
@@ -688,28 +711,19 @@ def run_sampled_preset(args, spec):
     # variance-only placement of the white-background second moment
     # NaN'd femnist at the reference lr).  A checkpoint trained on
     # differently-scaled gradients must never resume into a rescaled
-    # run.  The .partial-merge stamp is derived from this one so the
-    # two can never drift.
-    stamp = {"label_noise": args.label_noise, "rounds": args.rounds,
+    # run.  The .partial-merge stamp is the SAME dict (advisor r4:
+    # dropping epochs let a stale .partial from a different --epochs
+    # merge into a resumed run); stamp policy, incl. why the horizon
+    # is excluded, lives in check_config_stamp's docstring.
+    stamp = {"label_noise": args.label_noise,
              "epochs": cfg.epochs, "lr": cfg.lr, "seed": 0,
              "standin_rev": spec.get("standin_rev", 1)}
-    stamp_for_partial = {k: v for k, v in stamp.items() if k != "epochs"}
+    stamp_for_partial = stamp
     mgr = None
     start_round = 0
     if getattr(args, "checkpoint_dir", ""):
         ckdir = os.path.join(args.checkpoint_dir, tag)
-        stamp_path = os.path.join(ckdir, "config_stamp.json")
-        os.makedirs(ckdir, exist_ok=True)
-        if os.path.exists(stamp_path):
-            prior = json.load(open(stamp_path))
-            if prior != stamp:
-                raise SystemExit(
-                    f"checkpoint dir {ckdir} holds a run with a different "
-                    f"config ({prior} != {stamp}); pass --checkpoint-dir "
-                    "'' or remove the directory")
-        else:
-            with open(stamp_path, "w") as f:
-                json.dump(stamp, f)
+        check_config_stamp(ckdir, stamp)
         mgr = CheckpointManager(ckdir, max_to_keep=2)
         if mgr.latest_step() is not None:
             sim.state = mgr.restore(like=sim.state)
@@ -736,6 +750,17 @@ def run_sampled_preset(args, spec):
             prior_traj = [r for r in prior.get("trajectory", [])
                           if r["round"] < start_round]
             prior_wall = prior.get("wall_clock_s", 0.0)
+        else:
+            # a resumed run whose pre-resume rows are silently dropped
+            # mis-reports rounds_to_target (the exact bug the merge
+            # exists to fix) — make the skip LOUD (review r5); legacy
+            # pre-r5 partials (stamp carried 'rounds', lacked 'epochs')
+            # also land here rather than re-opening the epochs hole
+            print(f"[{tag}] WARNING: {out}.partial stamp "
+                  f"{prior.get('stamp')} != {stamp_for_partial}; "
+                  "pre-resume trajectory rows will NOT be merged — "
+                  "rounds_to_target/wall_clock cover only this session",
+                  flush=True)
 
     t0 = time.time()
 

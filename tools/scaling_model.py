@@ -140,6 +140,141 @@ def model_efficiency(t_compute: float, v_bytes: int, n: int,
     }
 
 
+_DTYPE_BYTES = {"pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2,
+                "bf16": 2, "f16": 2, "s32": 4, "u32": 4, "f32": 4,
+                "s64": 8, "u64": 8, "f64": 8, "c64": 8, "c128": 16}
+_SHAPE_RE = None  # compiled lazily (module imports stay cheap)
+_COLL_RE = None
+
+
+def parse_collective_bytes(hlo_text: str) -> dict:
+    """Per-kind payload bytes of the cross-device collectives in an
+    optimized-HLO dump: for each ``all-reduce``/``all-gather``/
+    ``reduce-scatter``/``collective-permute``/``all-to-all`` op (and
+    async ``-start`` form; ``-done`` consumes the started op and is
+    skipped) sum the byte size of its OUTPUT shape(s).  For an
+    all-reduce the output equals the payload V, so the ring wire
+    traffic is 2·V·(N−1)/N per link — the exact term
+    ``model_efficiency`` charges."""
+    import re
+
+    global _SHAPE_RE, _COLL_RE
+    if _SHAPE_RE is None:
+        _SHAPE_RE = re.compile(r"([a-z]+[0-9]*)\[([0-9,]*)\]")
+        _COLL_RE = re.compile(
+            r"=\s+((?:\([^)]*\))|(?:[a-z]+[0-9]*\[[0-9,]*\]\S*))\s+"
+            r"(all-reduce|all-gather|reduce-scatter|collective-permute"
+            r"|all-to-all)(-start)?\(")
+    out: dict = {}
+    for m in _COLL_RE.finditer(hlo_text):
+        sig, kind = m.group(1), m.group(2)
+        total = 0
+        for dt, dims in _SHAPE_RE.findall(sig):
+            if dt not in _DTYPE_BYTES:
+                continue
+            n = 1
+            for d in dims.split(","):
+                if d:
+                    n *= int(d)
+            total += n * _DTYPE_BYTES[dt]
+        out[kind] = out.get(kind, 0) + total
+        out["n_ops"] = out.get("n_ops", 0) + 1
+    return out
+
+
+def measure_hlo_volume(n_devices: int = 8, model: str = "resnet56") -> dict:
+    """Compile the ACTUAL north-star SPMD round program
+    (``parallel/spmd.py make_spmd_round_fn``, one client per chip) on
+    the current backend's n-device mesh and count the bytes its
+    compiled collectives move — turning the scaling model's
+    ``payload_bytes`` volume term from an assumption into a
+    measurement (VERDICT r4 weak #3).  Needs n_devices visible (the
+    faked-CPU-mesh recipe); ``main()`` runs it via a subprocess so the
+    real-chip session can still produce the artifact.
+
+    ``model='logreg'`` swaps in a small model for CI (the collective
+    payload is the variable tree — model-dependent — so the test pins
+    the MECHANISM; the artifact records the resnet56 number)."""
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.algorithms.fedavg import ServerState
+    from fedml_tpu.core.client import make_client_optimizer, make_local_update
+    from fedml_tpu.core.types import pack_clients
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.parallel.spmd import (
+        make_client_mesh,
+        make_spmd_round_fn,
+        replicate,
+        shard_client_block,
+    )
+
+    if model == "resnet56":
+        from fedml_tpu.models.resnet import resnet56
+
+        bundle = resnet56(num_classes=10)
+        input_shape = (32, 32, 3)
+    else:
+        from fedml_tpu.models.linear import logistic_regression
+
+        bundle = logistic_regression(64, 10)
+        input_shape = (64,)
+
+    mesh = make_client_mesh(n_devices)
+    ds = synthetic_classification(
+        num_train=n_devices * 4, num_test=8, input_shape=input_shape,
+        num_classes=10, num_clients=n_devices, partition="homo", seed=0,
+    )
+    opt = make_client_optimizer("sgd", 0.1, momentum=0.9)
+    local_update = make_local_update(bundle, opt, epochs=1)
+    round_fn = make_spmd_round_fn(mesh, local_update, donate=False)
+    key = jax.random.PRNGKey(0)
+    state = ServerState(variables=bundle.init(key), opt_state=(),
+                        round_idx=jnp.zeros((), jnp.int32), key=key)
+    pack = pack_clients(ds, list(range(n_devices)), batch_size=4)
+    args = shard_client_block(mesh, (
+        jnp.asarray(pack.x), jnp.asarray(pack.y), jnp.asarray(pack.mask),
+        jnp.asarray(pack.num_samples), jnp.ones(n_devices, jnp.float32),
+        jnp.arange(n_devices, dtype=jnp.int32),
+    ))
+    hlo = round_fn.lower(replicate(mesh, state), *args).compile().as_text()
+    tree_bytes = int(sum(
+        np.prod(l.shape) * 4
+        for l in jax.tree_util.tree_leaves(jax.eval_shape(bundle.init, key))
+    ))
+    return {
+        "n_devices": n_devices,
+        "model": model,
+        "variable_tree_fp32_bytes": tree_bytes,
+        "hlo_collective_bytes": parse_collective_bytes(hlo),
+    }
+
+
+def hlo_volume_via_subprocess(n_devices: int = 8) -> dict:
+    """Run measure_hlo_volume on a faked n-device CPU mesh in a fresh
+    interpreter (the current session may hold the real single-chip TPU
+    backend, which cannot fake devices)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + f" --xla_force_host_platform_device_count="
+                          f"{n_devices}").strip()
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__), "--hlo-volume",
+         "--devices", str(n_devices)],
+        env=env, capture_output=True, text=True,
+    )
+    if out.returncode != 0:
+        # surface the subprocess's own diagnostics — a bare
+        # CalledProcessError would discard the only useful error text
+        raise RuntimeError(
+            f"--hlo-volume subprocess failed (exit {out.returncode}):\n"
+            f"{out.stderr.strip()[-2000:]}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
 def measure_sampled_pack(chunk_rounds: int = 25):
     """HOST cost of the scheduled-cohort driver's chunk assembly
     (``run_fused_sampled``): draw + pack ``chunk_rounds`` mnist_lr
@@ -226,13 +361,47 @@ def main():
                    help="s/round on one chip (bench r3 measured ladder, "
                    "rpc=80 default: 28,818 samples/s over 15,360 "
                    "samples/round — PROFILE.md r3 table)")
-    p.add_argument("--out", default="SCALING_r04.json")
+    p.add_argument("--out", default="SCALING_r05.json")
     p.add_argument("--merge", default="SCALING_r02.json",
                    help="carry over the measured clients-per-chip ladder")
+    p.add_argument("--hlo-volume", action="store_true",
+                   help="(internal) print measure_hlo_volume JSON on the "
+                   "current backend and exit — run with a faked CPU mesh")
+    p.add_argument("--devices", type=int, default=8)
+    p.add_argument("--hlo-model", default="resnet56")
     args = p.parse_args()
+
+    if args.hlo_volume:
+        # sitecustomize pins JAX_PLATFORMS=axon at interpreter start, so
+        # the subprocess env alone is too late — override via config
+        # before the first device query (the conftest recipe); the
+        # XLA_FLAGS device-count fake was set before interpreter start
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps(measure_hlo_volume(args.devices, args.hlo_model)))
+        return
 
     t_compute = measure_t_compute() if args.measure else args.t_compute
     v = payload_bytes()
+
+    # pin the volume term to what XLA actually emits: compile the SPMD
+    # round on a faked 8-device CPU mesh and count collective payloads
+    # (VERDICT r4 weak #3 — the model's most load-bearing constant)
+    hlo = hlo_volume_via_subprocess(8)
+    ar_bytes = hlo["hlo_collective_bytes"].get("all-reduce", 0)
+    hlo_section = {
+        "method": "compiled the north-star SPMD round "
+                  "(make_spmd_round_fn, one client/chip, resnet56) on a "
+                  "faked 8-device CPU mesh; summed collective payloads "
+                  "from the optimized HLO (parse_collective_bytes)",
+        "hlo_collective_bytes": hlo["hlo_collective_bytes"],
+        "assumed_payload_bytes": v,
+        "allreduce_vs_assumed_ratio": round(ar_bytes / v, 5) if v else None,
+        "note": "all-reduce payload = V in the 2V(N-1)/N ring wire "
+                "term; the excess over the variable tree is the psum'd "
+                "scalar train metrics",
+    }
 
     chips = [model_efficiency(t_compute, v, n) for n in (8, 64, 256)]
     dcn = model_efficiency(t_compute, v, 1024, bw=V5E_DCN_BW)
@@ -243,7 +412,7 @@ def main():
     dcn["sensitivity"] = sensitivity_bounds(t_compute, v)
 
     artifact = {
-        "round": 4,
+        "round": 5,
         "model": {
             "scenario": "weak scaling, north-star cross-silo FedAvg: "
                         "fixed clients/chip, one psum all-reduce of the "
@@ -256,7 +425,10 @@ def main():
                 "payload_bytes": v,
                 "payload_source": "fp32 byte size of the aggregated "
                                   "resnet56 variable tree (params + BN "
-                                  "stats), counted from the pytree",
+                                  "stats), counted from the pytree; "
+                                  "VALIDATED against compiled HLO — see "
+                                  "hlo_validation",
+                "hlo_validation": hlo_section,
                 "ici_bw_bytes_per_s": V5E_ICI_BW,
                 "ici_source": "v5e per-link one-way ICI (scaling book); "
                               "model uses ONE axis ONE direction of the "

@@ -55,6 +55,7 @@ def build_north_star(
     unroll: int = 4,
     rounds_per_call: int = NORTH_STAR_RPC,
     client_unroll: int = 1,
+    conv_variant: str = "baseline",
 ):
     """The canonical bench workload, shared with tools/scaling_model.py
     so the scaling model's measured t_compute is BY CONSTRUCTION the
@@ -69,9 +70,22 @@ def build_north_star(
         resolve_compute_dtype,
     )
     from fedml_tpu.core.client import make_client_optimizer, make_local_update
-    from fedml_tpu.models.resnet import resnet56
 
-    bundle = resnet56(num_classes=10)
+    if conv_variant == "baseline":
+        from fedml_tpu.models.resnet import resnet56
+
+        bundle = resnet56(num_classes=10)
+    else:
+        # TPU-retiled EXECUTION variants of the SAME model (identical
+        # params + function, pinned by tests/test_resnet_tpu.py):
+        # s2d1/s2d2/s2d3 = space-to-depth through stages 1..k;
+        # pad32 = stage-1 lane padding
+        from fedml_tpu.models.resnet_tpu import resnet56_tpu
+
+        kw = {"s2d1": {"s2d_stages": 1}, "s2d2": {"s2d_stages": 2},
+              "s2d3": {"s2d_stages": 3},
+              "pad32": {"pad_stage1_to": 32}}[conv_variant]
+        bundle = resnet56_tpu(num_classes=10, **kw)
     opt = make_client_optimizer("sgd", 0.001, momentum=0.9, weight_decay=0.001)
     local_update = make_local_update(
         bundle, opt, epochs=epochs,
@@ -115,6 +129,7 @@ def build_fedllm(
     dtype: str = "bf16",
     unroll: int = 1,
     rounds_per_call: int = FEDLLM_RPC,
+    remat: bool = False,
 ):
     """MXU-friendly federated-LLM workload (the ``fedllm`` experiment
     family): next-token training of a GPT-2-shaped decoder (default
@@ -139,7 +154,7 @@ def build_fedllm(
 
     bundle = transformer_lm(
         vocab_size=vocab, embed_dim=embed_dim, num_heads=num_heads,
-        num_layers=num_layers, seq_len=seq_len,
+        num_layers=num_layers, seq_len=seq_len, remat=remat,
     )
     opt = make_client_optimizer("sgd", 3e-4)
     local_update = make_local_update(
@@ -231,6 +246,15 @@ def main():
         "training, reported as MFU (the second perf datapoint — "
         "demonstrates the framework on an MXU-friendly model)",
     )
+    p.add_argument(
+        "--conv-variant",
+        choices=["baseline", "s2d1", "s2d2", "s2d3", "pad32"],
+        default="baseline",
+        help="north_star conv execution variant (models/resnet_tpu.py): "
+        "same model/params/function (parity-tested), retiled for MXU "
+        "lanes — s2dK folds 2x2 spatial blocks into channels through "
+        "stage K; pad32 zero-pads stage-1's 16-wide convs to 32 lanes",
+    )
     p.add_argument("--seq-len", type=int, default=1024)
     p.add_argument("--embed-dim", type=int, default=1280,
                    help="1280/h10 measured best on v5e (width sweep at "
@@ -241,6 +265,12 @@ def main():
     p.add_argument("--num-layers", type=int, default=12)
     p.add_argument("--num-heads", type=int, default=10)
     p.add_argument("--vocab", type=int, default=8192)
+    p.add_argument(
+        "--remat", action="store_true",
+        help="checkpoint each transformer Block (recompute activations "
+        "in the backward): ~1/3 more FLOPs for O(layers) less live HBM "
+        "— required for width >=1536 at batch 8x1024 on one v5e",
+    )
     args = p.parse_args()
     # workload-aware defaults: the fedllm model is ~50x the FLOPs and
     # memory per sample of the ResNet workload, so sharing the
@@ -274,7 +304,7 @@ def main():
             embed_dim=args.embed_dim, num_heads=args.num_heads,
             num_layers=args.num_layers, epochs=args.epochs,
             dtype=args.dtype, unroll=args.unroll,
-            rounds_per_call=args.rounds_per_call,
+            rounds_per_call=args.rounds_per_call, remat=args.remat,
         )
         med, state = measure_rounds(round_fn, state, call_args, args.rounds)
         tflops = tokens_per_call * fpt / med
@@ -319,6 +349,7 @@ def main():
         epochs=args.epochs, dtype=args.dtype, unroll=args.unroll,
         rounds_per_call=args.rounds_per_call,
         client_unroll=args.client_unroll,
+        conv_variant=args.conv_variant,
     )
     med, state = measure_rounds(round_fn, state, call_args, args.rounds)
     sps = samples_per_call / med

@@ -1,0 +1,105 @@
+"""The TPU-retiled ResNet variants must be EXECUTION changes only:
+identical variable tree, identical function, identical gradients
+(models/resnet_tpu.py vs models/resnet.py).  Uses resnet20-scale
+Bottleneck stacks ([1,1,1]/[2,2,2]) to keep CPU compile time sane —
+every code path (stem s2d, stride-1 s2d blocks, s2d→normal and
+s2d→s2d transitions, lane-padded stage) is exercised."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.models.base import ModelBundle
+from fedml_tpu.models.resnet import Bottleneck, CifarResNet
+from fedml_tpu.models.resnet_tpu import (
+    CifarResNetTPU,
+    depth_to_space,
+    s2d_kernel_stride1,
+    space_to_depth,
+)
+
+
+def _baseline(layers=(1, 1, 1)):
+    return ModelBundle(
+        module=CifarResNet(block=Bottleneck, layers=layers, num_classes=10),
+        input_shape=(32, 32, 3),
+    )
+
+
+def _variant(layers=(1, 1, 1), **kw):
+    return ModelBundle(
+        module=CifarResNetTPU(layers=layers, num_classes=10, **kw),
+        input_shape=(32, 32, 3),
+    )
+
+
+def test_s2d_roundtrip_and_kernel_equivalence():
+    x = jax.random.normal(jax.random.PRNGKey(0), (2, 8, 8, 5))
+    np.testing.assert_array_equal(
+        np.asarray(depth_to_space(space_to_depth(x))), np.asarray(x)
+    )
+    # conv(s2d(x), W') == s2d(conv(x, w)) for stride-1 SAME convs
+    for k in (1, 3):
+        w = jax.random.normal(jax.random.PRNGKey(k), (k, k, 5, 7))
+        ref = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME", dimension_numbers=("NHWC", "HWIO", "NHWC")
+        )
+        got = jax.lax.conv_general_dilated(
+            space_to_depth(x), s2d_kernel_stride1(w), (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(space_to_depth(ref)),
+            rtol=1e-5, atol=1e-5,
+        )
+
+
+@pytest.mark.parametrize("kw", [
+    {},                      # plain re-implementation parity
+    {"s2d_stages": 1},       # stage-1 s2d, s2d->normal transition
+    {"s2d_stages": 2},       # s2d->s2d transition exercised
+    {"s2d_stages": 3},       # all stages + s2d global pool
+    {"pad_stage1_to": 32},   # lane padding
+])
+def test_variant_matches_baseline(kw):
+    base = _baseline((2, 2, 2))
+    var = _variant((2, 2, 2), **kw)
+    rng = jax.random.PRNGKey(0)
+    variables = base.init(rng)
+    # identical variable tree: the variant consumes baseline variables
+    vshapes = jax.tree_util.tree_map(jnp.shape, var.init(rng))
+    bshapes = jax.tree_util.tree_map(jnp.shape, variables)
+    assert jax.tree_util.tree_structure(vshapes) == \
+        jax.tree_util.tree_structure(bshapes)
+    assert vshapes == bshapes
+
+    x = jax.random.normal(jax.random.PRNGKey(1), (4, 32, 32, 3))
+    np.testing.assert_allclose(
+        np.asarray(var.apply_eval(variables, x)),
+        np.asarray(base.apply_eval(variables, x)),
+        rtol=2e-4, atol=2e-5,
+    )
+
+    # train mode: logits, updated BatchNorm stats, and parameter
+    # gradients of a softmax-CE loss must all agree
+    y = jnp.arange(4) % 10
+
+    def loss(b):
+        def f(params):
+            logits, newv = b.apply_train({**variables, "params": params}, x)
+            logp = jax.nn.log_softmax(logits)
+            return -jnp.take_along_axis(logp, y[:, None], 1).mean(), newv
+        return jax.value_and_grad(f, has_aux=True)(variables["params"])
+
+    (lb, nvb), gb = loss(base)
+    (lv, nvv), gv = loss(var)
+    np.testing.assert_allclose(float(lv), float(lb), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(nvv["batch_stats"]),
+                    jax.tree_util.tree_leaves(nvb["batch_stats"])):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(gv),
+                    jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=5e-4, atol=5e-5)

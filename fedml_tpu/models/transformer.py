@@ -96,6 +96,14 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     attn_fn: Optional[AttnFn] = None
     pos_offset_fn: Optional[Callable] = None  # (local_len) -> global offset
+    # gradient rematerialization: checkpoint each Block's activations
+    # and recompute them in the backward pass — trades ~1/3 more FLOPs
+    # for O(layers) less live-activation HBM, the standard lever that
+    # lets one v5e chip train widths past GPT-2-Large (width 1536 OOMs
+    # at batch 8x1024 without it — PROFILE.md).  Parameter tree is
+    # unchanged (nn.remat is a lifted transform), pinned by
+    # tests/test_models.py::test_transformer_remat_same_function
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -109,8 +117,12 @@ class TransformerLM(nn.Module):
             )
         wpe = nn.Embed(self.max_len, self.embed_dim, name="wpe")
         h = h + wpe(pos0 + jnp.arange(L))[None]
-        for _ in range(self.num_layers):
-            h = Block(self.num_heads, attn_fn=self.attn_fn)(h)
+        # explicit names keep the parameter tree identical with and
+        # without remat (nn.remat would auto-name "CheckpointBlock_i")
+        block_cls = nn.remat(Block) if self.remat else Block
+        for i in range(self.num_layers):
+            h = block_cls(self.num_heads, attn_fn=self.attn_fn,
+                          name=f"Block_{i}")(h)
         h = nn.LayerNorm()(h)
         # weight-tied head
         return tok.attend(h)
@@ -119,12 +131,13 @@ class TransformerLM(nn.Module):
 def transformer_lm(
     vocab_size=256, embed_dim=128, num_heads=4, num_layers=2, seq_len=256,
     attn_fn: Optional[AttnFn] = None, max_len: Optional[int] = None,
+    remat: bool = False,
 ) -> ModelBundle:
     return ModelBundle(
         module=TransformerLM(
             vocab_size=vocab_size, embed_dim=embed_dim, num_heads=num_heads,
             num_layers=num_layers, max_len=max_len or seq_len,
-            attn_fn=attn_fn,
+            attn_fn=attn_fn, remat=remat,
         ),
         input_shape=(seq_len,),
         input_dtype=jnp.int32,

@@ -50,6 +50,7 @@ def sequence_parallel_lm(
     attn_impl: str = "lax",
     flash_block: Optional[int] = None,
     flash_interpret: bool = False,
+    remat: bool = False,
 ):
     """Build (module, init, apply) where ``apply(variables, tokens)``
     runs the forward with the sequence dim sharded over ``axis``.
@@ -71,7 +72,7 @@ def sequence_parallel_lm(
         )
     module = TransformerLM(
         vocab_size=vocab_size, embed_dim=embed_dim, num_heads=num_heads,
-        num_layers=num_layers, max_len=max_len,
+        num_layers=num_layers, max_len=max_len, remat=remat,
         # "flash": the pallas-kernel ring path (ring_flash_attention) —
         # ~2x per-step attention at long shard lengths on TPU pods;
         # "lax" (default) is the portable blockwise ring.  flash_block

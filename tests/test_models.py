@@ -101,3 +101,40 @@ def test_gkt_split_resnets_compose():
     (tl, tf), new_vars = client.apply_train(cvars, x)
     assert tl.shape == (2, 3) and tf.shape == feats.shape
     assert "batch_stats" in new_vars
+
+
+def test_transformer_remat_same_function():
+    """remat=True is an execution change only (nn.remat lifted
+    transform): identical parameter tree, identical logits, identical
+    gradients — just less live-activation memory."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from fedml_tpu.models.transformer import transformer_lm
+
+    plain = transformer_lm(vocab_size=50, embed_dim=32, num_heads=2,
+                           num_layers=2, seq_len=16)
+    ckpt = transformer_lm(vocab_size=50, embed_dim=32, num_heads=2,
+                          num_layers=2, seq_len=16, remat=True)
+    variables = plain.init(jax.random.PRNGKey(0))
+    assert jax.tree_util.tree_map(jnp.shape, ckpt.init(
+        jax.random.PRNGKey(0))) == jax.tree_util.tree_map(
+        jnp.shape, variables)
+    toks = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0, 50)
+    tgt = jnp.roll(toks, -1, 1)
+
+    def loss(bundle, params):
+        logits = bundle.apply_eval({**variables, "params": params}, toks)
+        logp = jax.nn.log_softmax(logits)
+        return -jnp.take_along_axis(logp, tgt[..., None], -1).mean()
+
+    la, ga = jax.value_and_grad(lambda p: loss(plain, p))(
+        variables["params"])
+    lb, gb = jax.value_and_grad(lambda p: loss(ckpt, p))(
+        variables["params"])
+    np.testing.assert_allclose(float(lb), float(la), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(ga),
+                    jax.tree_util.tree_leaves(gb)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=1e-5, atol=1e-6)

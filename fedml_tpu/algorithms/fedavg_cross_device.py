@@ -17,6 +17,7 @@ where "messages" are XLA collectives.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, Optional
 
 import jax
@@ -196,7 +197,10 @@ class FedAvgServerManager(NodeManager):
                 [e["variables"] for e in entries],
                 [e["n"] / total for e in entries],
             )
-        rec = {"round": self.round_idx, "participants": sorted(self.pending)}
+        # wall-clock close stamp: deltas between consecutive recs are
+        # the per-round wall time a federation artifact reports
+        rec = {"round": self.round_idx, "participants": sorted(self.pending),
+               "t": round(time.time(), 3)}
         dropped = sorted(sampled - set(self.pending))
         if dropped:
             rec["dropped"] = dropped  # deadline expired without them

@@ -25,12 +25,29 @@ LR_FEATURES = 10000
 LR_TAGS = 500
 
 
+def zipf_weights(vocab: int, s: float = 1.1) -> np.ndarray:
+    """Zipf(s) unigram distribution over token ids (rank = id).  Real
+    text is zipfian; a UNIFORM-unigram chain was measured unlearnable
+    at the reference row's SGD lr (r5 pilot: loss 9.211→9.207 over 100
+    rounds at lr 10^-0.5, 3x faster at lr 1.0 but still glacial, NaN
+    at 3.0) — every one of the 10k classes needs its own averaged-over
+    -clients signal.  Zipf jumps give the head words the same
+    many-sightings-per-round head start real NWP training has."""
+    q = 1.0 / np.arange(1, vocab + 1, dtype=np.float64) ** s
+    return q / q.sum()
+
+
 def _peaked_chain(rng, n: int, vocab: int, eta: float,
-                  chunk: int = 1 << 25) -> np.ndarray:
+                  chunk: int = 1 << 25,
+                  jump_q: "np.ndarray | None" = None,
+                  ) -> "tuple[np.ndarray, np.ndarray]":
     """Length-n peaked Markov chain over [0, vocab): follow a fixed
     permutation with prob 1−η, jump uniform with prob η — the
-    calibrated-text methodology of ``data/shakespeare.py``, with the
-    documented Bayes next-token accuracy ceiling (1−η) + η/vocab.
+    calibrated-text methodology of ``data/shakespeare.py``.  Returns
+    ``(chain, perm)`` — the permutation is the Bayes predictor, with
+    accuracy ceiling (1−η) + η·E[q(perm(cur))] (= (1−η) + η/vocab for
+    uniform jumps; ``jump_q`` draws jump targets from a given unigram
+    distribution instead, e.g. ``zipf_weights``).
     (Shakespeare's in-place sampler is deliberately NOT refactored onto
     this helper: its exact RNG stream is what the rev'd stand-in data
     and r4 artifacts were produced from — changing its draw order would
@@ -51,13 +68,18 @@ def _peaked_chain(rng, n: int, vocab: int, eta: float,
         # would be 1.0 — not a calibrated task
         raise ValueError(f"peaked chain needs jump rate eta > 0, got {eta}")
     perm = rng.permutation(vocab).astype(np.int32)
+    cdf = None if jump_q is None else np.cumsum(jump_q)
     out = np.empty(n, np.int32)
     carry = None
     done = 0
     while done < n:
         m = min(chunk, n - done)
         jump = rng.rand(m) < eta
-        unif = rng.randint(0, vocab, size=m).astype(np.int32)
+        if cdf is None:
+            unif = rng.randint(0, vocab, size=m).astype(np.int32)
+        else:  # jump targets ~ jump_q (zipf): inverse-CDF sampling
+            unif = np.searchsorted(cdf, rng.rand(m)).astype(np.int32)
+            np.clip(unif, 0, vocab - 1, out=unif)
         # chunk boundary: index 0 is always a segment start for the
         # bookkeeping, but its VALUE follows the chain dynamics — the
         # drawn jump[0] decides uniform (keep unif[0]) vs continue the
@@ -76,7 +98,7 @@ def _peaked_chain(rng, n: int, vocab: int, eta: float,
         out[done:done + m] = powers[k, unif[seg_start]]
         carry = out[done + m - 1]
         done += m
-    return out
+    return out, perm
 
 
 def nwp_chain_ceiling(eta: float, vocab: int = NWP_VOCAB) -> float:
@@ -93,6 +115,7 @@ def load_stackoverflow_nwp(
     seed: int = 0,
     standin_peak_eta: float = None,
     standin_test_sequences: int = 2000,
+    standin_zipf_s: float = 1.1,
 ) -> FedDataset:
     h5path = os.path.join(data_dir, "stackoverflow_nwp.pkl")
     tr = os.path.join(data_dir, "stackoverflow_train.h5")
@@ -146,21 +169,37 @@ def load_stackoverflow_nwp(
             rng.lognormal(mean=4.6, sigma=0.8, size=num_clients), 16, 512
         ).astype(np.int64)
         total = int(sizes.sum()) + standin_test_sequences
-        chain = _peaked_chain(
-            rng, total * (NWP_SEQ_LEN + 1), NWP_VOCAB, standin_peak_eta
-        ) + 4
-        win = chain.reshape(total, NWP_SEQ_LEN + 1).astype(np.int16)
+        q = (zipf_weights(NWP_VOCAB, standin_zipf_s)
+             if standin_zipf_s else None)
+        chain, perm = _peaked_chain(
+            rng, total * (NWP_SEQ_LEN + 1), NWP_VOCAB, standin_peak_eta,
+            jump_q=q,
+        )
+        # Bayes next-token accuracy of THIS chain (predict perm(cur)):
+        # right when the chain followed the permutation (1−η) plus the
+        # chance a jump landed there — η·q(perm(cur)) averaged over the
+        # chain's own stationary distribution (empirical over a 1M-token
+        # sample; exactly η/V when jumps are uniform)
+        eta = standin_peak_eta
+        if q is None:
+            ceiling = (1.0 - eta) + eta / NWP_VOCAB
+        else:
+            cur = chain[: 1 << 20]
+            ceiling = float((1.0 - eta) + eta * np.mean(q[perm[cur]]))
+        win = (chain + 4).reshape(total, NWP_SEQ_LEN + 1).astype(np.int16)
         bounds = np.concatenate([[0], np.cumsum(sizes)])
         idx = {c: np.arange(bounds[c], bounds[c + 1])
                for c in range(num_clients)}
         test = win[bounds[-1]:]
-        return FedDataset(
+        ds = FedDataset(
             train_x=win[:bounds[-1], :-1], train_y=win[:bounds[-1], 1:],
             test_x=test[:, :-1], test_y=test[:, 1:],
             train_client_idx=idx, test_client_idx=None,
             num_classes=NWP_EXTENDED,
             name="stackoverflow_nwp(synthetic-standin)",
         )
+        ds.standin_bayes_ceiling = round(ceiling, 6)
+        return ds
 
     def block(n):
         steps = rng.randint(-50, 51, size=n * (NWP_SEQ_LEN + 1))

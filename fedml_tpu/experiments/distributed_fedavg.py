@@ -133,7 +133,11 @@ def run_server(args) -> None:
     )
     # startup barrier: the hub drops frames to unregistered receivers,
     # so broadcasting before every client registered would hang
-    backend.await_peers(range(1, args.num_clients + 1))
+    # registration barrier budget scales with the federation size: on
+    # a 1-core host N client processes SERIALIZE their jax imports
+    # (~8 s each), so a fixed 60 s cap spuriously fails at N >= ~8
+    backend.await_peers(range(1, args.num_clients + 1),
+                        timeout=60 + 15 * args.num_clients)
     server.start()
     backend.run()  # returns when finish() closes the socket
     if args.out:
@@ -189,6 +193,7 @@ def launch(
     slow_client_delay: float = 0.0,
     kill_slow_client_after: float = 0.0,
     env=None,
+    server_env=None,
     timeout: float = 180.0,
 ):
     """Spawn hub + server + clients as OS processes and wait for the
@@ -243,9 +248,13 @@ def launch(
             for j in range(extra_idle_clients)
         ]
         procs += idle
+        # server_env lets the SERVER run on a different backend than the
+        # clients — e.g. aggregation on the one real TPU chip while 16
+        # client processes train on CPU (only one process may hold the
+        # tunnel lease)
         server = subprocess.Popen(
             me + ["--role", "server", "--out", out_path] + common,
-            env=env,
+            env=dict(server_env) if server_env is not None else env,
         )
         procs.append(server)
         if kill_slow_client_after and slow_client_delay:
@@ -258,7 +267,7 @@ def launch(
 
             mon = TcpBackend(9998, "127.0.0.1", port)
             mon.await_peers([0] + list(range(1, num_clients + 1)),
-                            timeout=60)
+                            timeout=60 + 15 * num_clients)
             mon.stop()
             time.sleep(kill_slow_client_after)
             clients[-1].kill()
@@ -269,7 +278,8 @@ def launch(
             from fedml_tpu.comm.tcp import TcpBackend
 
             monitor = TcpBackend(9999, "127.0.0.1", port)
-            monitor.await_peers([num_clients + 1], timeout=60)
+            monitor.await_peers([num_clients + 1],
+                                timeout=60 + 15 * num_clients)
             if kill_idle_after:
                 time.sleep(kill_idle_after)
             idle[0].kill()

@@ -254,13 +254,29 @@ def test_stackoverflow_peaked_chain_ceiling():
 
     rng = np.random.RandomState(0)
     V, eta, n = 50, 0.3, 200_000
-    chain = _peaked_chain(rng, n, V, eta)
+    chain, perm = _peaked_chain(rng, n, V, eta)
     assert chain.min() >= 0 and chain.max() < V
     succ = np.zeros((V, V), np.int64)
     np.add.at(succ, (chain[:-1], chain[1:]), 1)
     pred = succ.argmax(1)  # recovers the permutation
+    np.testing.assert_array_equal(pred, perm)
     acc = (pred[chain[:-1]] == chain[1:]).mean()
     assert abs(acc - nwp_chain_ceiling(eta, V)) < 0.01
+
+    # zipf-jump mode: the Bayes predictor is still perm, and the
+    # loader's empirically-derived ceiling matches the chain
+    from fedml_tpu.data.stackoverflow import zipf_weights
+
+    q = zipf_weights(V, 1.1)
+    zchain, zperm = _peaked_chain(np.random.RandomState(1), n, V, 0.75,
+                                  jump_q=q)
+    zacc = (zperm[zchain[:-1]] == zchain[1:]).mean()
+    want = 0.25 + 0.75 * np.mean(q[zperm[zchain[:-1]]])
+    assert abs(zacc - want) < 0.01
+    # head-heavy unigram: top 10% of ids carry several times their
+    # uniform share (10%) of the mass
+    counts = np.bincount(zchain, minlength=V)
+    assert counts[: V // 10].sum() > 0.3 * n
 
 
 def test_stackoverflow_nwp_peaked_standin():
@@ -272,6 +288,7 @@ def test_stackoverflow_nwp_peaked_standin():
     ds = load_stackoverflow_nwp(data_dir="/nonexistent", num_clients=40,
                                 standin_peak_eta=0.75,
                                 standin_test_sequences=16)
+    assert 0.2 < ds.standin_bayes_ceiling < 0.3
     assert ds.num_classes == NWP_EXTENDED
     assert ds.train_x.dtype == np.int16
     assert ds.train_x.shape[1] == NWP_SEQ_LEN

@@ -113,9 +113,13 @@ def main():
         for rec in recs:
             if not rec["participants"]:
                 # the server treats a zero-participant round as a no-op
-                # (fedavg_cross_device._close_round dropped_all path);
-                # replaying it as an all-zero mask would zero the model
-                # and fabricate a parity failure (review r5)
+                # for the MODEL but still advances round_idx
+                # (fedavg_cross_device._close_round) — and clients key
+                # their next round's rng on that index, so the oracle
+                # must advance it too (review r5: replaying with an
+                # all-zero mask would zero the model; skipping without
+                # advancing would desync every later round's shuffle)
+                st = st._replace(round_idx=st.round_idx + 1)
                 continue
             part = np.zeros(args.clients, np.float32)
             part[[n - 1 for n in rec["participants"]]] = 1.0

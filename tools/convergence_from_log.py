@@ -120,6 +120,12 @@ def main():
     p.add_argument("--num-train", type=int, default=50000)
     p.add_argument("--num-test", type=int, default=10000)
     p.add_argument("--platform", default="tpu")
+    # the reconstructed artifact must describe the run the LOG came
+    # from — the r5 northstar matrix spans (model, num_classes) rows
+    p.add_argument("--model", choices=["resnet56", "mobilenet"],
+                   default="resnet56")
+    p.add_argument("--num-classes", type=int, choices=[10, 100],
+                   default=10)
     args = p.parse_args()
 
     ceiling = 1.0 - args.label_noise
@@ -146,7 +152,9 @@ def main():
                              num_test=args.num_test,
                              augment=bool(args.augment),
                              smooth_sigma=args.smooth_sigma,
-                             flip_symmetric=bool(args.flip_symmetric)),
+                             flip_symmetric=bool(args.flip_symmetric),
+                             model=args.model,
+                             num_classes=args.num_classes),
         "provenance": "reconstructed from the streamed run logs "
                       f"({', '.join(os.path.basename(l) for l in args.logs)}) "
                       "by tools/convergence_from_log.py",

@@ -31,6 +31,7 @@ import numpy as np
 
 from fedml_tpu.core.client import LocalUpdateFn, eval_summary, make_client_optimizer, make_evaluator, make_local_update
 from fedml_tpu.core.losses import LossFn, masked_softmax_ce
+from fedml_tpu.core.metrics import MetricsLogger
 from fedml_tpu.core.types import (
     FedDataset,
     batch_eval_pack,
@@ -379,15 +380,20 @@ class FedAvgSimulation:
         local_update: Optional[LocalUpdateFn] = None,
         augment_fn: Optional[Callable] = None,
         client_lr: Optional[Any] = None,
+        metrics: Optional[MetricsLogger] = None,
     ):
         """``client_lr`` overrides ``config.lr`` for the client optimizer
         and may be an optax schedule (count -> lr), e.g. FedNAS's
         per-epoch cosine — every other config knob (prox_mu, grad_clip,
-        compute_dtype, augment_fn) keeps applying unchanged."""
+        compute_dtype, augment_fn) keeps applying unchanged.
+        ``metrics`` is the observability sink (spans + JSONL + telemetry);
+        omitted, a file-less logger still feeds the process telemetry
+        registry so counters/histograms accumulate either way."""
         self.bundle = bundle
         self.dataset = dataset
         self.cfg = config
         self.loss_fn = loss_fn
+        self.metrics = metrics or MetricsLogger()
         optimizer = make_client_optimizer(
             config.client_optimizer,
             config.lr if client_lr is None else client_lr,
@@ -407,8 +413,19 @@ class FedAvgSimulation:
         )
         self._server_update = server_update
         self._aggregate_transform = aggregate_transform
-        self.round_fn = jax.jit(self._build_round_fn())
-        self.evaluator = make_evaluator(bundle, loss_fn)
+        # compile-event tracking per jit signature (obs layer): a cohort
+        # geometry that varies per round shows up as jax.compiles{fn=
+        # round_fn} climbing instead of sitting at 1-2 (recompile storm)
+        from fedml_tpu.obs.jax_hooks import instrument_jit
+
+        self.round_fn = instrument_jit(
+            jax.jit(self._build_round_fn()), "round_fn",
+            telemetry=self.metrics.telemetry,
+        )
+        self.evaluator = instrument_jit(
+            make_evaluator(bundle, loss_fn), "evaluator",
+            telemetry=self.metrics.telemetry,
+        )
 
         key = jax.random.PRNGKey(config.seed)
         variables = bundle.init(key)
@@ -429,6 +446,13 @@ class FedAvgSimulation:
         self.history = []
         # (cohort key, device-resident packed block) — see _device_pack
         self._pack_cache: Optional[tuple] = None
+        # logical model payload per participant per direction (fp32 wire
+        # bytes) — the simulation's comm accounting (_record_sim_comm)
+        self._model_nbytes = sum(
+            int(getattr(l, "size", 1))
+            * int(getattr(getattr(l, "dtype", None), "itemsize", 4) or 4)
+            for l in jax.tree_util.tree_leaves(variables)
+        )
 
     def _build_round_fn(self):
         """Subclass hook: FedNova etc. swap in a different round kernel."""
@@ -486,10 +510,34 @@ class FedAvgSimulation:
     def _annotate_round(self, out: dict, ids, round_idx: int) -> None:
         """Subclass hook: add per-round fields to the metrics row."""
 
+    def _record_sim_comm(self, cohort: int, rounds: int = 1,
+                         uploads: Optional[int] = None) -> None:
+        """Logical federation traffic for simulated rounds: the server
+        syncs the model to each sampled participant and receives one
+        update back from each SURVIVING one (S2C_SYNC_MODEL down,
+        C2S_SEND_MODEL up) — the bytes a real transport would move, on
+        the SAME counter series the comm backends use, so
+        ``tools/trace_summary.py`` renders one table for simulated and
+        message-driven runs alike.  ``uploads`` defaults to the full
+        cohort; dispatch rounds pass the realized participation count
+        (a dropped client never sends its update), fused drivers the
+        expectation (their drop draws happen on device)."""
+        t = self.metrics.telemetry
+        down = cohort * rounds
+        up = down if uploads is None else uploads
+        t.inc("comm.sent_msgs", down, msg_type="S2C_SYNC_MODEL")
+        t.inc("comm.sent_bytes", self._model_nbytes * down,
+              msg_type="S2C_SYNC_MODEL")
+        t.inc("comm.recv_msgs", up, msg_type="C2S_SEND_MODEL")
+        t.inc("comm.recv_bytes", self._model_nbytes * up,
+              msg_type="C2S_SEND_MODEL")
+
     def run_round(self) -> dict:
         round_idx = int(self.state.round_idx)
-        ids = self._sample_ids(round_idx)
-        x, y, mask, num_samples = self._cohort_block(ids, round_idx)
+        with self.metrics.span("sample"):
+            ids = self._sample_ids(round_idx)
+        with self.metrics.span("pack"):
+            x, y, mask, num_samples = self._cohort_block(ids, round_idx)
         participation = jnp.ones(len(ids), jnp.float32)
         if self.cfg.drop_prob > 0.0:
             from fedml_tpu.core.sampling import inject_dropout
@@ -498,16 +546,24 @@ class FedAvgSimulation:
                 jax.random.PRNGKey(self.cfg.seed), round_idx, participation,
                 self.cfg.drop_prob,
             )
-        self.state, metrics = self.round_fn(
-            self.state,
-            x,
-            y,
-            mask,
-            num_samples,
-            participation,
-            jnp.asarray(ids, jnp.int32),
+        with self.metrics.span("round"):
+            self.state, metrics = self.round_fn(
+                self.state,
+                x,
+                y,
+                mask,
+                num_samples,
+                participation,
+                jnp.asarray(ids, jnp.int32),
+            )
+            # the float() readbacks force device completion, so the span
+            # measures the real round, not the async enqueue
+            out = {k: float(v) for k, v in metrics.items()}
+        # realized upload count: dropped clients received the sync but
+        # never sent a model back (participation already synced above)
+        self._record_sim_comm(
+            len(ids), uploads=int(round(float(participation.sum())))
         )
-        out = {k: float(v) for k, v in metrics.items()}
         out["round"] = round_idx
         if out.get("count", 0) > 0:
             out["train_acc"] = out["correct"] / out["count"]
@@ -534,8 +590,18 @@ class FedAvgSimulation:
                 r % self.cfg.frequency_of_the_test == 0
                 or i == rounds - 1
             ):
-                metrics.update(self.evaluate_global())
+                with self.metrics.span("eval"):
+                    metrics.update(self.evaluate_global())
                 metrics.update(self._extra_eval())
+                # eval rounds are the natural cadence for the device
+                # HBM high-water gauge (None-guarded on CPU backends)
+                from fedml_tpu.obs.jax_hooks import record_device_memory
+
+                record_device_memory(self.metrics.telemetry)
+            # per-round spans (time_sample/pack/round/eval) land in the
+            # history row AND the metrics.jsonl record stream
+            metrics.update(self.metrics.pop_spans())
+            self.metrics.log(metrics, step=r)
             self.history.append(metrics)
             if log_fn:
                 log_fn(metrics)
@@ -589,11 +655,14 @@ class FedAvgSimulation:
         kernel = self._build_round_fn()
         fns: dict = {}
 
+        from fedml_tpu.obs.jax_hooks import instrument_jit
+
         def fused(n):
             if n not in fns:
-                fns[n] = jax.jit(make_multi_round_fn(
+                fns[n] = instrument_jit(jax.jit(make_multi_round_fn(
                     None, n, drop_prob=cfg.drop_prob, round_fn=kernel,
-                ))
+                )), f"multi_round_fn[{n}]",
+                    telemetry=self.metrics.telemetry)
             return fns[n]
 
         def run_chunk(base, n, chunk_ids):
@@ -629,23 +698,45 @@ class FedAvgSimulation:
             n = next_eval - base + 1
             if rounds_per_call:
                 n = min(n, rounds_per_call)
-            chunk_ids = [ids_for_round(base + i) for i in range(n)]
+            with self.metrics.span("sample"):
+                chunk_ids = [ids_for_round(base + i) for i in range(n)]
+            # run_chunk dispatches the fused program (its own span("pack")
+            # covers host-side block building in the sampled driver); the
+            # device work itself completes under the float() readbacks
+            # below, so span("round") brackets those — one span per fused
+            # chunk of n rounds, attached to the chunk's last row
             stacked = run_chunk(base, n, chunk_ids)
-            rows = []
-            for i in range(n):
-                out = {k: float(v[i]) for k, v in stacked.items()}
-                out["round"] = base + i
-                if out.get("count", 0) > 0:
-                    out["train_acc"] = out["correct"] / out["count"]
-                    out["train_loss"] = out["loss_sum"] / out["count"]
-                self._annotate_round(out, chunk_ids[i], base + i)
-                rows.append(out)
+            with self.metrics.span("round"):
+                rows = []
+                for i in range(n):
+                    out = {k: float(v[i]) for k, v in stacked.items()}
+                    out["round"] = base + i
+                    if out.get("count", 0) > 0:
+                        out["train_acc"] = out["correct"] / out["count"]
+                        out["train_loss"] = out["loss_sum"] / out["count"]
+                    self._annotate_round(out, chunk_ids[i], base + i)
+                    rows.append(out)
+            # fused drivers draw dropout ON DEVICE: the host can't see
+            # the realized masks, so uploads use the expectation
+            cohort = len(chunk_ids[0])
+            self._record_sim_comm(
+                cohort, rounds=n,
+                uploads=int(round(cohort * n * (1.0 - self.cfg.drop_prob))),
+            )
             if base + n - 1 in eval_rounds:
-                rows[-1].update(self.evaluate_global())
+                with self.metrics.span("eval"):
+                    rows[-1].update(self.evaluate_global())
                 rows[-1].update(self._extra_eval())
+                from fedml_tpu.obs.jax_hooks import record_device_memory
+
+                record_device_memory(self.metrics.telemetry)
+            # chunk-level spans ride the chunk's LAST row (one fused call
+            # serves n rounds; per-round attribution does not exist here)
+            rows[-1].update(self.metrics.pop_spans())
             self.history.extend(rows)
-            if log_fn:
-                for r in rows:
+            for r in rows:
+                self.metrics.log(r, step=r.get("round"))
+                if log_fn:
                     log_fn(r)
             done += n
         return self.history
@@ -680,18 +771,21 @@ class FedAvgSimulation:
         # scans the data's leading [R] axis, so jit specializes per
         # input shape on its own (unlike run_fused, where R is baked
         # into make_multi_round_fn's program)
-        fused = jax.jit(make_scheduled_multi_round_fn(
+        from fedml_tpu.obs.jax_hooks import instrument_jit
+
+        fused = instrument_jit(jax.jit(make_scheduled_multi_round_fn(
             None, drop_prob=cfg.drop_prob, drop_seed=cfg.seed,
             round_fn=self._build_round_fn(),
-        ))
+        )), "scheduled_round_fn", telemetry=self.metrics.telemetry)
 
         def run_chunk(base, n, chunk_ids):
-            blocks = [self._cohort_block(ids, base + i)
-                      for i, ids in enumerate(chunk_ids)]
-            stacked_args = tuple(
-                jnp.stack([jnp.asarray(b[j]) for b in blocks])
-                for j in range(4)
-            )
+            with self.metrics.span("pack"):
+                blocks = [self._cohort_block(ids, base + i)
+                          for i, ids in enumerate(chunk_ids)]
+                stacked_args = tuple(
+                    jnp.stack([jnp.asarray(b[j]) for b in blocks])
+                    for j in range(4)
+                )
             part = jnp.ones((n, len(chunk_ids[0])), jnp.float32)
             sids = jnp.asarray(np.stack(chunk_ids), jnp.int32)
             self.state, stacked = fused(

@@ -42,6 +42,7 @@ from fedml_tpu.comm.message import (
 from fedml_tpu.core import tree as treelib
 from fedml_tpu.core.client import LocalUpdateFn
 from fedml_tpu.core.types import FedDataset, pack_clients
+from fedml_tpu.obs.telemetry import get_telemetry
 
 SERVER = 0
 
@@ -189,18 +190,24 @@ class FedAvgServerManager(NodeManager):
         if self._deadline_timer is not None:
             self._deadline_timer.cancel()
         sampled = set(self._sampled_nodes())
+        time_agg = 0.0
         if not dropped_all:
             # aggregate: sample-weighted average (FedAVGAggregator.py:58-87)
+            t0 = time.perf_counter()
             entries = list(self.pending.values())
             total = sum(e["n"] for e in entries)
             self.variables = treelib.tree_weighted_sum(
                 [e["variables"] for e in entries],
                 [e["n"] / total for e in entries],
             )
+            time_agg = time.perf_counter() - t0
+            # same span series the simulation drivers feed (obs layer):
+            # the reference's FedAVGAggregator.py:59,85-86 aggregate timer
+            get_telemetry().observe("span.agg_s", time_agg)
         # wall-clock close stamp: deltas between consecutive recs are
         # the per-round wall time a federation artifact reports
         rec = {"round": self.round_idx, "participants": sorted(self.pending),
-               "t": round(time.time(), 3)}
+               "time_agg": round(time_agg, 6), "t": round(time.time(), 3)}
         dropped = sorted(sampled - set(self.pending))
         if dropped:
             rec["dropped"] = dropped  # deadline expired without them

@@ -43,6 +43,7 @@ class FedAvgRobustSimulation(FedAvgSimulation):
         poison_fraction: float = 0.3,
         poison: Optional[PoisonedData] = None,
         loss_fn: LossFn = masked_softmax_ce,
+        **kwargs,
     ):
         transform = (
             None
@@ -52,7 +53,8 @@ class FedAvgRobustSimulation(FedAvgSimulation):
             )
         )
         super().__init__(
-            bundle, dataset, config, loss_fn=loss_fn, aggregate_transform=transform
+            bundle, dataset, config, loss_fn=loss_fn,
+            aggregate_transform=transform, **kwargs
         )
         self.attacker_client = attacker_client
         self.attack_freq = max(1, attack_freq)

@@ -382,7 +382,7 @@ class FedNASSearch:
 def fednas_train_stage(
     genotype: Genotype, dataset: FedDataset, config: FedAvgConfig,
     *, C: int = 36, layers: int = 20, image_size: int = 32,
-    in_channels: int = 3, lr_min: float = 0.001,
+    in_channels: int = 3, lr_min: float = 0.001, metrics=None,
 ) -> FedAvgSimulation:
     """Stage 2 (``--stage train``): plain federated training of the fixed
     network — the FedAvg engine on the derived genotype.
@@ -402,4 +402,5 @@ def fednas_train_stage(
     )
     # client_lr override: every other FedAvgConfig knob (prox_mu,
     # grad_clip, compute_dtype, ...) keeps applying
-    return FedAvgSimulation(bundle, dataset, config, client_lr=schedule)
+    return FedAvgSimulation(bundle, dataset, config, client_lr=schedule,
+                            metrics=metrics)

@@ -164,6 +164,7 @@ class FedNovaSimulation(FedAvgSimulation):
         *,
         gmf: float = 0.0,
         loss_fn: LossFn = masked_softmax_ce,
+        **kwargs,
     ):
         if config.client_optimizer != "sgd":
             raise ValueError("FedNova requires the SGD client optimizer")
@@ -173,7 +174,7 @@ class FedNovaSimulation(FedAvgSimulation):
                 "SGD(+momentum); grad_clip/weight_decay are unsupported"
             )
         self._gmf = gmf
-        super().__init__(bundle, dataset, config, loss_fn=loss_fn)
+        super().__init__(bundle, dataset, config, loss_fn=loss_fn, **kwargs)
         if gmf > 0.0:
             self.state = self.state._replace(
                 opt_state=treelib.tree_zeros_like(self.state.variables["params"])

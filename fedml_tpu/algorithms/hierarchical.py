@@ -102,27 +102,36 @@ class HierarchicalSimulation(FedAvgSimulation):
                 key=jax.random.fold_in(self.state.key, 1000 + g),
             )
             ids = np.asarray(client_ids)
-            (px, py, pm, pns), group_total = self._group_pack(g, ids)
-            for gr in range(self.group_comm_round):
-                gstate, metrics = self.round_fn(
-                    gstate,
-                    px, py, pm, pns,
-                    jnp.ones(len(ids), jnp.float32),
-                    jnp.asarray(ids, jnp.int32),
-                )
-                # metrics cover EVERY in-group round, not just the last
-                for k in agg_metrics:
-                    agg_metrics[k] += float(metrics[k])
+            with self.metrics.span("pack"):
+                (px, py, pm, pns), group_total = self._group_pack(g, ids)
+            with self.metrics.span("round"):
+                for gr in range(self.group_comm_round):
+                    gstate, metrics = self.round_fn(
+                        gstate,
+                        px, py, pm, pns,
+                        jnp.ones(len(ids), jnp.float32),
+                        jnp.asarray(ids, jnp.int32),
+                    )
+                    # metrics cover EVERY in-group round, not just the last
+                    for k in agg_metrics:
+                        agg_metrics[k] += float(metrics[k])
+            # per-group traffic: every in-group round syncs the group
+            # model to each member and collects each member's update
+            self._record_sim_comm(len(ids), rounds=self.group_comm_round)
             group_vars.append(gstate.variables)
             group_weights.append(group_total)
 
-        total = sum(group_weights)
-        new_vars = treelib.tree_weighted_sum(
-            group_vars, [w / total for w in group_weights]
-        )
-        new_vars = jax.tree_util.tree_map(
-            lambda s, ref: s.astype(ref.dtype), new_vars, self.state.variables
-        )
+        with self.metrics.span("agg"):
+            # the group→global weighted average runs on HOST — this is
+            # the hierarchy's own aggregation tier, reported as time_agg
+            total = sum(group_weights)
+            new_vars = treelib.tree_weighted_sum(
+                group_vars, [w / total for w in group_weights]
+            )
+            new_vars = jax.tree_util.tree_map(
+                lambda s, ref: s.astype(ref.dtype), new_vars,
+                self.state.variables,
+            )
         self.state = ServerState(
             variables=new_vars,
             opt_state=self.state.opt_state,

@@ -26,9 +26,11 @@ shutdown message, and device-side sync is XLA's.
 from __future__ import annotations
 
 import abc
-from typing import Callable, Dict, List
+import time
+from typing import Callable, Dict, List, Optional
 
 from fedml_tpu.comm.message import Message
+from fedml_tpu.obs import comm_obs
 
 Handler = Callable[[Message], None]
 
@@ -64,7 +66,17 @@ class CommBackend(abc.ABC):
     def remove_observer(self, obs: Observer) -> None:
         self._observers.remove(obs)
 
-    def _notify(self, msg: Message) -> None:
+    def _record_send(self, msg: Message, nbytes: Optional[int],
+                     seconds: Optional[float]) -> None:
+        """Transports call this from ``send_message`` with the wire size
+        (exact, or ``comm_obs.message_nbytes`` where nothing serializes)
+        and the time spent serializing+writing."""
+        comm_obs.record_send(msg.type, nbytes, seconds)
+
+    def _notify(self, msg: Message, nbytes: Optional[int] = None) -> None:
+        # recv-side telemetry lives in the observer-notify path, so every
+        # transport and every NodeManager is measured with no changes
+        comm_obs.record_recv(msg.type, nbytes)
         for obs in list(self._observers):
             obs.receive_message(msg.type, msg)
 
@@ -98,7 +110,13 @@ class NodeManager(Observer):
             raise KeyError(
                 f"node {self.backend.node_id}: no handler for {msg_type!r}"
             )
-        handler(msg)
+        t0 = time.perf_counter()
+        try:
+            handler(msg)
+        finally:
+            # handler latency = the node's real work per message type
+            # (server aggregate, client local train)
+            comm_obs.record_handle(msg_type, time.perf_counter() - t0)
 
     def send_message(self, msg: Message) -> None:
         self.backend.send_message(msg)

@@ -11,11 +11,13 @@ have no equivalent here.
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Dict
 
 from fedml_tpu.comm.backend import CommBackend
 from fedml_tpu.comm.message import Message
+from fedml_tpu.obs.comm_obs import message_nbytes
 
 
 class InprocBus:
@@ -50,7 +52,11 @@ class InprocBus:
             msg = self._fifo.popleft()
             if self.stopped.get(msg.receiver, True):
                 continue
-            self._backends[msg.receiver]._notify(msg)
+            # wire size stamped once at send time (the bus never
+            # serializes; re-estimating per delivery would double cost)
+            self._backends[msg.receiver]._notify(
+                msg, nbytes=getattr(msg, "wire_nbytes", None)
+            )
             delivered += 1
         raise RuntimeError("inproc bus did not quiesce (message storm?)")
 
@@ -65,7 +71,10 @@ class InprocBackend(CommBackend):
         bus.attach(self)
 
     def send_message(self, msg: Message) -> None:
+        t0 = time.perf_counter()
+        msg.wire_nbytes = message_nbytes(msg)
         self.bus.route(msg)
+        self._record_send(msg, msg.wire_nbytes, time.perf_counter() - t0)
 
     def run(self) -> None:
         # synchronous: delivery is driven by bus.drain()

@@ -22,6 +22,7 @@ import json
 import logging
 import socket
 import threading
+import time
 from typing import Dict
 
 from fedml_tpu.comm.backend import CommBackend
@@ -214,8 +215,12 @@ class TcpBackend(CommBackend):
     def send_message(self, msg: Message) -> None:
         # to_json() is already one valid JSON line (newlines escape inside
         # JSON strings) — no re-parse needed
+        t0 = time.perf_counter()
+        data = (msg.to_json() + "\n").encode()
         with self._send_lock:
-            self._sock.sendall((msg.to_json() + "\n").encode())
+            self._sock.sendall(data)
+        # exact wire bytes; latency covers serialize + socket write
+        self._record_send(msg, len(data), time.perf_counter() - t0)
 
     def await_peers(self, ids, timeout: float = 60.0) -> None:
         """Block until every node id in ``ids`` is registered at the hub.
@@ -337,7 +342,7 @@ class TcpBackend(CommBackend):
             if frame.get("__hub__") == "stop":
                 return
             try:
-                self._notify(Message.from_obj(frame))
+                self._notify(Message.from_obj(frame), nbytes=len(line))
             except Exception:
                 # a handler error must not kill the reader thread — the
                 # node would silently stop receiving and the federation

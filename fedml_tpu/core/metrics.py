@@ -8,10 +8,17 @@ scattered through the code (SURVEY.md §5.1/§5.5: aggregate time
 ``FedAVGAggregator.py:100-101,154``).  Here one sink owns all of it:
 
 - ``MetricsLogger``: ``log(dict)`` → JSON-lines file + python logging
-  + optional wandb, with the standard keys (round/epoch/spans).
+  + optional wandb, with the standard keys (round/epoch/spans).  A
+  context manager with idempotent ``close()``; the record stream also
+  carries the process-wide ``obs.telemetry`` registry (counter
+  snapshots via ``log_telemetry``, compile/trace events drained as
+  their own ``kind``-tagged records) so one ``metrics.jsonl`` is the
+  whole story ``tools/trace_summary.py`` reads.
 - ``span(name)``: context manager producing the same named spans as the
-  reference (``time_aggregate``, ``time_round``, ...).
-- ``trace(dir)``: ``jax.profiler`` trace context for TPU timelines.
+  reference (``time_aggregate``, ``time_round``, ...); each span also
+  feeds the ``span.<name>_s`` telemetry histogram.
+- ``trace(dir)``: ``jax.profiler`` trace context for TPU timelines,
+  defaulting into the logger's ``run_dir``.
 """
 
 from __future__ import annotations
@@ -23,6 +30,8 @@ import os
 import time
 from typing import Any, Dict, Optional
 
+from fedml_tpu.obs.telemetry import Telemetry, get_telemetry
+
 logger = logging.getLogger("fedml_tpu")
 
 
@@ -32,8 +41,10 @@ class MetricsLogger:
         run_dir: Optional[str] = None,
         use_wandb: bool = False,
         wandb_kwargs: Optional[dict] = None,
+        telemetry: Optional[Telemetry] = None,
     ):
         self.run_dir = run_dir
+        self.telemetry = telemetry or get_telemetry()
         self._fh = None
         if run_dir:
             os.makedirs(run_dir, exist_ok=True)
@@ -50,44 +61,98 @@ class MetricsLogger:
                 logger.warning("wandb requested but unavailable; file/log only")
         self.spans: Dict[str, float] = {}
 
-    def log(self, metrics: Dict[str, Any], step: Optional[int] = None) -> None:
+    def _write(self, record: dict) -> None:
+        # serialize once, and only when someone is listening: with no
+        # JSONL file and logging above INFO this is a no-op, so the
+        # always-on round instrumentation costs nothing in quiet runs
+        if self._fh is None and not logger.isEnabledFor(logging.INFO):
+            return
+        line = json.dumps(record, default=float)
+        logger.info("metrics %s", line)
+        if self._fh:
+            self._fh.write(line + "\n")
+            self._fh.flush()
+
+    def log(self, metrics: Dict[str, Any], step: Optional[int] = None) -> dict:
         record = dict(metrics)
         if step is not None:
             record.setdefault("round", step)
-        if self.spans:
-            record.update({f"time_{k}": v for k, v in self.spans.items()})
-            self.spans = {}
+        # pending spans attach to ROUND rows only: an event record
+        # (kind=trace/compile/...) logged mid-round must not steal the
+        # in-flight time_* spans from the next round row
+        if self.spans and "kind" not in record:
+            record.update(self.pop_spans())
         record.setdefault("ts", time.time())
-        logger.info("metrics %s", json.dumps(record, default=float))
-        if self._fh:
-            self._fh.write(json.dumps(record, default=float) + "\n")
-            self._fh.flush()
+        self._write(record)
         if self._wandb:
             self._wandb.log(record, step=step)
+        return record
+
+    def log_telemetry(self) -> dict:
+        """Merge the telemetry registry into the record stream: pending
+        events (compile, trace_rounds, ...) become their own records,
+        then one ``kind=telemetry`` snapshot of every counter / gauge /
+        histogram is written.  Call at eval boundaries and at shutdown."""
+        for ev in self.telemetry.drain_events():
+            self._write(ev)
+        record = {"kind": "telemetry", "ts": time.time(),
+                  **self.telemetry.snapshot()}
+        self._write(record)
+        return record
 
     @contextlib.contextmanager
     def span(self, name: str):
         """Named wall-clock span, attached to the next ``log`` call —
-        the reference's manual time-logging pattern, centralized."""
+        the reference's manual time-logging pattern, centralized.
+        Repeated spans of one name ACCUMULATE until popped (a round that
+        packs twice reports the sum); each individual span additionally
+        lands in the ``span.<name>_s`` telemetry histogram."""
         t0 = time.perf_counter()
         try:
             yield
         finally:
-            self.spans[name] = self.spans.get(name, 0.0) + (
-                time.perf_counter() - t0
-            )
+            dt = time.perf_counter() - t0
+            self.spans[name] = self.spans.get(name, 0.0) + dt
+            self.telemetry.observe(f"span.{name}_s", dt)
 
-    def close(self):
+    def pop_spans(self) -> Dict[str, float]:
+        """Pending spans as ``time_<name>`` keys; clears the accumulator."""
+        out = {f"time_{k}": v for k, v in self.spans.items()}
+        self.spans = {}
+        return out
+
+    def close(self) -> None:
+        """Idempotent: safe to call twice, safe after ``with`` exit."""
         if self._fh:
             self._fh.close()
             self._fh = None
 
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.close()
+        return False
+
 
 @contextlib.contextmanager
-def trace(log_dir: str = "/tmp/fedml_tpu_trace"):
-    """``jax.profiler`` trace context (open with TensorBoard/XProf)."""
+def trace(log_dir: Optional[str] = None, logger: Optional[MetricsLogger] = None):
+    """``jax.profiler`` trace context (open with TensorBoard/XProf).
+
+    ``log_dir`` defaults to ``<logger.run_dir>/trace`` when a logger
+    with a run_dir is given (so the trace lands next to metrics.jsonl),
+    else ``/tmp/fedml_tpu_trace``; the chosen path is logged into the
+    metrics stream so the run record points at its own trace.
+    """
     import jax
 
+    if log_dir is None:
+        if logger is not None and logger.run_dir:
+            log_dir = os.path.join(logger.run_dir, "trace")
+        else:
+            log_dir = "/tmp/fedml_tpu_trace"
+    if logger is not None:
+        logger.log({"kind": "trace", "trace_dir": log_dir})
     jax.profiler.start_trace(log_dir)
     try:
         yield log_dir

@@ -19,10 +19,12 @@ from __future__ import annotations
 
 import dataclasses
 import json
+import os
 import sys
 import time
 
 from fedml_tpu.core.config import config_to_json, parse_config
+from fedml_tpu.core.metrics import MetricsLogger, setup_logging
 from fedml_tpu.experiments.registry import (create_model, load_data,
                                             shrink_dataset,
                                             task_loss_for_dataset)
@@ -95,6 +97,10 @@ class ExperimentConfig:
     # the test set AFTER the real loader runs — the task is never swapped
     max_samples_per_client: int = 0
     max_test_samples: int = 0
+    # observability: every main() run emits <run_dir>/metrics.jsonl
+    # (per-round spans, comm counters, compile events — read it with
+    # tools/trace_summary.py); "" = auto runs/<algo>-<dataset>-<stamp>
+    run_dir: str = ""
 
 
 def _apply_ci(cfg: ExperimentConfig) -> ExperimentConfig:
@@ -129,7 +135,7 @@ def _apply_ci(cfg: ExperimentConfig) -> ExperimentConfig:
     return cfg
 
 
-def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
+def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn, metrics=None) -> dict:
     """Federated transformer fine-tuning over token sequences (the
     long-context family the reference lacks).  Three drivers:
 
@@ -165,7 +171,7 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
             lr=cfg.lr, momentum=cfg.momentum, weight_decay=cfg.wd,
             frequency_of_the_test=cfg.frequency_of_the_test, seed=cfg.seed,
             compute_dtype=cfg.compute_dtype or None, drop_prob=cfg.drop_prob,
-        ))
+        ), metrics=metrics)
         # run() already merges evaluate_global() into the final round
         hist = sim.run(log_fn=log_fn)
         return {"history": hist, "final": hist[-1],
@@ -285,16 +291,50 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn) -> dict:
         if r % cfg.frequency_of_the_test == 0 or r == cfg.comm_round - 1:
             row.update(eval_global(state.variables))
         hist.append(row)
+        if metrics is not None:
+            metrics.log(row, step=r)
         if log_fn:
             log_fn(row)
     return {"history": hist, "final": hist[-1], "mesh": str(mesh.shape),
             "wall_s": time.time() - t0}
 
 
-def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
+# sims that take the observability sink directly (per-round spans, comm
+# accounting, compile tracking live INSIDE their round loop); everything
+# else gets its history rows logged post-hoc by run_experiment
+_METRICS_NATIVE = frozenset((
+    "fedavg", "fedprox", "fedopt", "fednova", "fedavg_robust",
+    "hierarchical", "fedllm",
+))
+
+
+def run_experiment(cfg: ExperimentConfig, log_fn=print, metrics=None) -> dict:
     cfg = _apply_ci(cfg)
     t0 = time.time()
+    # a file-less logger still feeds the process telemetry registry;
+    # main() passes a run_dir-backed one so metrics.jsonl is emitted
+    metrics = metrics if metrics is not None else MetricsLogger()
+    out = _dispatch(cfg, log_fn, metrics, t0)
+    if cfg.algorithm not in _METRICS_NATIVE:
+        # post-hoc logging for drivers without a metrics sink of their
+        # own.  fednas --stage train already logged its TRAIN rounds
+        # live (metrics= threaded into fednas_train_stage) under the
+        # same round indices the search stage used — so the search rows
+        # are written as kind-tagged records, keeping exactly one plain
+        # round stream per file (trace_summary keys round rows on the
+        # absence of "kind")
+        search_aside = "train_history" in out
+        for row in out.get("history") or []:
+            if isinstance(row, dict):
+                if search_aside:
+                    metrics.log({"kind": "search_round", **row})
+                else:
+                    metrics.log(row, step=row.get("round"))
+    return out
 
+
+def _dispatch(cfg: ExperimentConfig, log_fn, metrics, t0) -> dict:
+    """Algorithm switch: build data/model/driver and run (L5 body)."""
     if cfg.algorithm == "base_framework":  # tutorial template: no model/data
         from fedml_tpu.algorithms.base_framework import run_base_framework
 
@@ -393,7 +433,7 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         if cfg.stage == "train":
             # reference train stage: SGD(momentum, wd) + grad clip
             # (FedNASTrainer.py:134-141,185) — same knobs as search
-            sim = fednas_train_stage(genotype, ds, FedAvgConfig(
+            sim = fednas_train_stage(genotype, ds, metrics=metrics, config=FedAvgConfig(
                 num_clients=ds.num_clients,
                 clients_per_round=cfg.client_num_per_round,
                 comm_rounds=cfg.comm_round, epochs=cfg.epochs,
@@ -406,7 +446,7 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
         return out
 
     if cfg.algorithm == "fedllm":
-        return _run_fedllm(cfg, ds, t0, log_fn)
+        return _run_fedllm(cfg, ds, t0, log_fn, metrics=metrics)
 
     bundle = create_model(cfg.model, cfg.dataset, ds.num_classes,
                           input_shape=tuple(ds.train_x.shape[1:]))
@@ -461,7 +501,7 @@ def run_experiment(cfg: ExperimentConfig, log_fn=print) -> dict:
     # their train transform — published accuracies are unreachable
     # without it (measured: the r3 north-star run memorized).  Here it
     # is the jit-compiled per-epoch augment inside the local update.
-    engine_kw = {}
+    engine_kw = {"metrics": metrics}
     if cfg.data_augmentation and ds.train_x.ndim == 4:
         from fedml_tpu.data.augment import make_image_augment
 
@@ -533,10 +573,33 @@ def main(argv=None):
     if cfg.algorithm not in ALGORITHMS:
         raise SystemExit(f"--algorithm must be one of {ALGORITHMS}")
     print(config_to_json(cfg))
-    out = run_experiment(cfg)
+    setup_logging()
+    from fedml_tpu.obs.jax_hooks import (install_jax_monitoring,
+                                         record_device_memory)
+
+    install_jax_monitoring()
+    # pid suffix: two arms of a sweep launched in the same wall-clock
+    # second must not append into one metrics.jsonl
+    run_dir = cfg.run_dir or os.path.join(
+        "runs",
+        f"{cfg.algorithm}-{cfg.dataset}-"
+        f"{time.strftime('%Y%m%d-%H%M%S')}-p{os.getpid()}",
+    )
+    # context manager: the JSONL handle closes on EVERY exit path —
+    # a crashed run still leaves a readable metrics.jsonl behind
+    with MetricsLogger(run_dir=run_dir) as metrics:
+        metrics.log({"kind": "config",
+                     **json.loads(config_to_json(cfg))})
+        # log_fn=None: with INFO logging on, MetricsLogger already
+        # surfaces every row on the console — print would double it
+        out = run_experiment(cfg, log_fn=None, metrics=metrics)
+        # final telemetry merge: device-memory high-water gauges, then
+        # counters/histograms + pending compile events into metrics.jsonl
+        record_device_memory(metrics.telemetry)
+        metrics.log_telemetry()
     tail = out.get("final") or (out["history"][-1] if out.get("history") else {})
-    print(json.dumps({"final": tail, "wall_s": round(out["wall_s"], 2)},
-                     default=str))
+    print(json.dumps({"final": tail, "wall_s": round(out["wall_s"], 2),
+                      "run_dir": run_dir}, default=str))
     return out
 
 

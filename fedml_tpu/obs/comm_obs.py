@@ -1,0 +1,83 @@
+"""Comm-layer instrumentation: per-message-type counters + latency.
+
+Wired into ``CommBackend``/``NodeManager`` (``fedml_tpu/comm/backend.py``)
+as observer-compatible middleware: the recv side records inside the base
+class's ``_notify`` (before observers run) and the send side inside each
+transport's ``send_message``, so server/client managers and algorithms
+need no changes to be measured.
+
+Series (naming convention in ``obs/telemetry.py``):
+
+- ``comm.sent_msgs{msg_type=...}`` / ``comm.recv_msgs{msg_type=...}``
+- ``comm.sent_bytes{msg_type=...}`` / ``comm.recv_bytes{msg_type=...}``
+  — serialized wire bytes (TCP: exact frame length; inproc: the
+  estimator below, since the deterministic bus never serializes)
+- ``comm.send_latency_s{msg_type=...}`` — histogram of time spent in
+  ``send_message`` (serialize + enqueue/socket write)
+- ``comm.handle_latency_s{msg_type=...}`` — histogram of handler time in
+  ``NodeManager.receive_message`` (the server's aggregate, the client's
+  local train)
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from fedml_tpu.obs.telemetry import Telemetry, get_telemetry
+
+_B64_FACTOR = 4.0 / 3.0  # base64 expansion of binary buffers on the wire
+
+
+def record_send(msg_type: str, nbytes: Optional[int], seconds: Optional[float],
+                telemetry: Optional[Telemetry] = None) -> None:
+    t = telemetry or get_telemetry()
+    t.inc("comm.sent_msgs", 1, msg_type=msg_type)
+    if nbytes:
+        t.inc("comm.sent_bytes", nbytes, msg_type=msg_type)
+    if seconds is not None and seconds >= 0:
+        t.observe("comm.send_latency_s", seconds, msg_type=msg_type)
+
+
+def record_recv(msg_type: str, nbytes: Optional[int] = None,
+                telemetry: Optional[Telemetry] = None) -> None:
+    t = telemetry or get_telemetry()
+    t.inc("comm.recv_msgs", 1, msg_type=msg_type)
+    if nbytes:
+        t.inc("comm.recv_bytes", nbytes, msg_type=msg_type)
+
+
+def record_handle(msg_type: str, seconds: float,
+                  telemetry: Optional[Telemetry] = None) -> None:
+    t = telemetry or get_telemetry()
+    if seconds >= 0:
+        t.observe("comm.handle_latency_s", seconds, msg_type=msg_type)
+
+
+def _value_nbytes(v) -> float:
+    """Approximate serialized size of one params value (see message.py
+    codecs) WITHOUT encoding it — inproc skips serialization entirely,
+    so its byte accounting must not pay a full ``to_json`` per message."""
+    if isinstance(v, dict):
+        if "__ndarray__" in v:  # already-encoded array: b64 string length
+            return len(v["__ndarray__"]) + 48
+        if "__wiretree__" in v:  # wire pytree: sum its encoded leaves
+            return sum(_value_nbytes(l) for l in v.get("leaves", ())) + 32
+        return sum(len(str(k)) + 4 + _value_nbytes(x) for k, x in v.items()) + 2
+    if isinstance(v, (list, tuple)):
+        return sum(_value_nbytes(x) for x in v) + 2
+    if isinstance(v, str):
+        return len(v) + 2
+    if isinstance(v, bool) or v is None:
+        return 5
+    if isinstance(v, (int, float)):
+        return 12
+    nbytes = getattr(v, "nbytes", None)  # numpy / jax array
+    if nbytes is not None:
+        return float(nbytes) * _B64_FACTOR + 48
+    return len(str(v))
+
+
+def message_nbytes(msg) -> int:
+    """Estimated JSON-line wire size of a ``Message`` envelope."""
+    return int(sum(len(k) + 4 + _value_nbytes(v)
+                   for k, v in msg.params.items()) + 2)

@@ -1,0 +1,313 @@
+"""Observability layer (ISSUE 1): telemetry registry, span lifecycle,
+comm counters on a real inproc exchange, compile tracking, and the
+trace_summary CLI over a produced metrics.jsonl."""
+
+import json
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from fedml_tpu.core.metrics import MetricsLogger
+from fedml_tpu.obs.telemetry import (
+    Histogram,
+    Telemetry,
+    metric_key,
+    parse_metric_key,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# --- histogram bucketing edge cases -----------------------------------------
+
+def test_histogram_log2_buckets_and_stats():
+    h = Histogram()
+    for v in (0.3, 0.6, 3.0, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    assert snap["count"] == 4
+    assert snap["sum"] == pytest.approx(8.9)
+    assert snap["min"] == pytest.approx(0.3)
+    assert snap["max"] == pytest.approx(5.0)
+    # 0.3→le 0.5, 0.6→le 1, 3.0→le 4, 5.0→le 8
+    assert snap["buckets"] == {"0.5": 1, "1.0": 1, "4.0": 1, "8.0": 1}
+
+
+def test_histogram_zero_gets_own_bucket():
+    h = Histogram()
+    h.observe(0.0)
+    h.observe(0.0)
+    assert h.buckets == {0.0: 2}
+    assert h.count == 2 and h.min == 0.0
+
+
+def test_histogram_rejects_nan_inf_negative():
+    h = Histogram()
+    for bad in (float("nan"), float("inf"), float("-inf"), -1.0):
+        with pytest.raises(ValueError):
+            h.observe(bad)
+    assert h.count == 0  # rejected observations leave no partial state
+
+
+def test_exact_power_of_two_lands_in_own_bucket():
+    h = Histogram()
+    h.observe(4.0)  # ceil(log2(4)) = 2 → le 4.0, not 8.0
+    assert h.buckets == {4.0: 1}
+
+
+# --- metric key naming convention -------------------------------------------
+
+def test_metric_key_sorted_labels_roundtrip():
+    key = metric_key("comm.sent_bytes", {"msg_type": "S2C_SYNC_MODEL"})
+    assert key == "comm.sent_bytes{msg_type=S2C_SYNC_MODEL}"
+    name, labels = parse_metric_key(key)
+    assert name == "comm.sent_bytes" and labels == {"msg_type": "S2C_SYNC_MODEL"}
+    # label order must not matter (sorted)
+    assert metric_key("x", {"b": 1, "a": 2}) == metric_key("x", {"a": 2, "b": 1}).replace(
+        "{a=2,b=1}", "{a=2,b=1}"
+    )
+    assert metric_key("x", {"b": 1, "a": 2}) == "x{a=2,b=1}"
+    assert parse_metric_key("plain") == ("plain", {})
+
+
+def test_telemetry_counters_gauges_snapshot():
+    t = Telemetry()
+    t.inc("c.n", 2, kind="a")
+    t.inc("c.n", 3, kind="a")
+    t.gauge_max("g.peak", 10)
+    t.gauge_max("g.peak", 7)  # high-water: keeps the max
+    t.observe("h.lat", 0.5)
+    snap = t.snapshot()
+    assert snap["counters"]["c.n{kind=a}"] == 5
+    assert snap["gauges"]["g.peak"] == 10
+    assert snap["hists"]["h.lat"]["count"] == 1
+    t.reset()
+    assert t.snapshot() == {"counters": {}, "gauges": {}, "hists": {}}
+
+
+# --- span lifecycle ----------------------------------------------------------
+
+def test_span_accumulates_across_repeats_and_nesting():
+    t = Telemetry()
+    m = MetricsLogger(telemetry=t)
+    with m.span("pack"):
+        pass
+    with m.span("pack"):  # repeated: accumulates until popped
+        with m.span("round"):  # nested different-name spans coexist
+            pass
+    assert set(m.spans) == {"pack", "round"}
+    spans = m.pop_spans()
+    assert set(spans) == {"time_pack", "time_round"}
+    assert spans["time_pack"] >= spans["time_round"]  # outer ⊇ inner
+    assert m.pop_spans() == {}  # popped clears
+    # every individual span also landed in the telemetry histogram
+    assert t.snapshot()["hists"]["span.pack_s"]["count"] == 2
+
+
+def test_span_recorded_on_exception_path():
+    m = MetricsLogger(telemetry=Telemetry())
+    with pytest.raises(RuntimeError):
+        with m.span("round"):
+            raise RuntimeError("boom")
+    assert "round" in m.spans  # finally-path accumulation
+
+
+# --- MetricsLogger lifecycle (satellite: context manager, idempotent close) --
+
+def test_metrics_logger_context_manager_closes_on_exception(tmp_path):
+    with pytest.raises(RuntimeError):
+        with MetricsLogger(run_dir=str(tmp_path), telemetry=Telemetry()) as m:
+            m.log({"loss": 1.0}, step=0)
+            raise RuntimeError("crash mid-run")
+    assert m._fh is None  # closed on the exception path
+    m.close()  # idempotent: second close is a no-op
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    assert lines and lines[0]["loss"] == 1.0  # the crashed run is readable
+
+
+def test_jsonl_schema_roundtrip_with_telemetry_snapshot(tmp_path):
+    t = Telemetry()
+    with MetricsLogger(run_dir=str(tmp_path), telemetry=t) as m:
+        t.inc("comm.sent_bytes", 1024, msg_type="X")
+        t.observe("comm.send_latency_s", 0.25, msg_type="X")
+        t.event("compile", fn="round_fn", seconds=1.5)
+        m.log({"loss": 0.5}, step=7)
+        m.log_telemetry()
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    kinds = [l.get("kind") for l in lines]
+    assert kinds == [None, "compile", "telemetry"]  # events drain before snapshot
+    assert lines[0]["round"] == 7
+    snap = lines[2]
+    assert snap["counters"]["comm.sent_bytes{msg_type=X}"] == 1024
+    hist = snap["hists"]["comm.send_latency_s{msg_type=X}"]
+    assert hist["count"] == 1 and hist["buckets"] == {"0.25": 1}
+
+
+# --- comm counters on an inproc echo exchange --------------------------------
+
+def test_inproc_echo_records_comm_counters():
+    from fedml_tpu.comm.inproc import InprocBus
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.obs.telemetry import get_telemetry
+
+    t = get_telemetry()
+    base_sent = t.counter_value("comm.sent_msgs", msg_type="OBS_ECHO")
+    base_bytes = t.counter_value("comm.sent_bytes", msg_type="OBS_ECHO")
+
+    bus = InprocBus()
+    a, b = bus.register(0), bus.register(1)
+
+    class Echo:
+        def receive_message(self, mt, msg):
+            if msg.receiver == 1:  # echo back once
+                reply = Message("OBS_ECHO", 1, 0)
+                reply.add_params("payload", msg.get("payload"))
+                b.send_message(reply)
+
+    class Sink:
+        def receive_message(self, mt, msg):
+            pass
+
+    b.add_observer(Echo())
+    a.add_observer(Sink())
+    m = Message("OBS_ECHO", 0, 1)
+    m.add_params("payload", np.ones((64, 64), np.float32))
+    a.send_message(m)
+    assert bus.drain() == 2  # request + echo
+
+    sent = t.counter_value("comm.sent_msgs", msg_type="OBS_ECHO") - base_sent
+    nbytes = t.counter_value("comm.sent_bytes", msg_type="OBS_ECHO") - base_bytes
+    recv = t.counter_value("comm.recv_msgs", msg_type="OBS_ECHO")
+    assert sent == 2 and recv >= 2
+    # 64x64 f32 = 16 KiB raw → > 20 KiB per message on the b64 wire, x2
+    assert nbytes > 2 * 16384
+    lat = t.snapshot()["hists"].get("comm.send_latency_s{msg_type=OBS_ECHO}")
+    assert lat and lat["count"] >= 2
+
+
+# --- compile tracking --------------------------------------------------------
+
+def test_instrument_jit_counts_signatures_not_calls():
+    import jax
+    import jax.numpy as jnp
+
+    from fedml_tpu.obs.jax_hooks import instrument_jit
+
+    t = Telemetry()
+    f = instrument_jit(jax.jit(lambda x: x * 2), "f", telemetry=t)
+    f(jnp.ones((4,)))
+    f(jnp.ones((4,)))  # warm: same signature, no new event
+    assert t.counter_value("jax.compiles", fn="f") == 1
+    f(jnp.ones((8,)))  # new shape → recompile
+    assert t.counter_value("jax.compiles", fn="f") == 2
+    events = t.drain_events()
+    assert [e["kind"] for e in events] == ["compile", "compile"]
+    assert all(e["seconds"] >= 0 for e in events)
+    # varying python scalars must NOT read as recompiles: jit weak-types
+    # a plain float to one dtype regardless of value
+    g = instrument_jit(jax.jit(lambda x, s: x * s), "g", telemetry=t)
+    for s in (1.0, 2.0, 3.0):
+        g(jnp.ones((4,)), s)
+    assert t.counter_value("jax.compiles", fn="g") == 1
+
+
+def test_record_device_memory_none_guarded():
+    from fedml_tpu.obs.jax_hooks import record_device_memory
+
+    # CPU devices may or may not implement memory_stats — the call must
+    # never raise either way
+    record_device_memory(Telemetry())
+
+
+# --- end-to-end: simulation emits, trace_summary reads -----------------------
+
+def _tiny_sim(tmp_path, telemetry):
+    from fedml_tpu.algorithms.fedavg import FedAvgConfig, FedAvgSimulation
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models.linear import logistic_regression
+
+    ds = synthetic_classification(num_train=60, num_test=20, input_shape=(8,),
+                                  num_classes=2, num_clients=3,
+                                  partition="homo", seed=0)
+    logger = MetricsLogger(run_dir=str(tmp_path), telemetry=telemetry)
+    sim = FedAvgSimulation(
+        logistic_regression(8, 2), ds,
+        FedAvgConfig(num_clients=3, clients_per_round=3, comm_rounds=2,
+                     epochs=1, batch_size=8, frequency_of_the_test=5),
+        metrics=logger,
+    )
+    return sim, logger
+
+
+def test_simulation_emits_spans_comm_and_compiles(tmp_path):
+    t = Telemetry()
+    sim, logger = _tiny_sim(tmp_path, t)
+    with logger:
+        sim.run()
+        logger.log_telemetry()
+    lines = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    rounds = [l for l in lines if "round" in l and "kind" not in l]
+    assert len(rounds) == 2
+    assert all("time_round" in r and "time_sample" in r and "time_pack" in r
+               for r in rounds)
+    assert "time_eval" in rounds[-1]  # final round evaluates
+    compiles = [l for l in lines if l.get("kind") == "compile"]
+    assert any(c["fn"] == "round_fn" for c in compiles)
+    snap = [l for l in lines if l.get("kind") == "telemetry"][-1]
+    sent = snap["counters"].get(
+        "comm.sent_bytes{msg_type=S2C_SYNC_MODEL}", 0)
+    # 3 clients x 2 rounds x model bytes — nonzero logical comm volume
+    assert sent > 0
+    assert snap["counters"]["jax.compiles{fn=round_fn}"] == 1  # no storm
+
+
+def test_trace_summary_cli_renders_and_json_parses(tmp_path):
+    t = Telemetry()
+    sim, logger = _tiny_sim(tmp_path, t)
+    with logger:
+        sim.run()
+        logger.log_telemetry()
+    script = str(REPO / "tools" / "trace_summary.py")
+    out = subprocess.run([sys.executable, script, str(tmp_path)],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    assert "per-round spans" in out.stdout
+    assert "S2C_SYNC_MODEL" in out.stdout
+    assert "compile" in out.stdout
+
+    out = subprocess.run([sys.executable, script, "--json", str(tmp_path)],
+                         capture_output=True, text=True, timeout=60)
+    assert out.returncode == 0, out.stderr
+    parsed = json.loads(out.stdout)  # machine-parseable, strict JSON
+    s = parsed[str(tmp_path)]
+    assert s["num_rounds"] == 2
+    assert s["comm"]["S2C_SYNC_MODEL"]["sent_bytes"] > 0
+    assert any(c["fn"] == "round_fn" for c in s["compiles"])
+    assert "time_round" in s["spans"]
+
+
+def test_trace_summary_cli_missing_input_exits_nonzero(tmp_path):
+    script = str(REPO / "tools" / "trace_summary.py")
+    out = subprocess.run(
+        [sys.executable, script, str(tmp_path / "does_not_exist")],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 2
+
+
+def test_trace_default_dir_from_logger_run_dir(tmp_path):
+    """Satellite: trace() must not hardcode /tmp when the logger has a
+    run_dir, and must log the trace path into the metrics stream."""
+    from fedml_tpu.core.metrics import trace
+
+    with MetricsLogger(run_dir=str(tmp_path), telemetry=Telemetry()) as m:
+        with trace(logger=m) as tdir:
+            assert tdir == os.path.join(str(tmp_path), "trace")
+    recs = [json.loads(l) for l in open(tmp_path / "metrics.jsonl")]
+    assert any(r.get("kind") == "trace" and r.get("trace_dir") == tdir
+               for r in recs)
+    assert os.path.isdir(tdir)  # the profiler actually wrote there

@@ -1,0 +1,286 @@
+#!/usr/bin/env python
+"""Trace-analysis CLI for the observability layer (fedml_tpu/obs).
+
+Reads one or more ``metrics.jsonl`` streams (pass a run dir or the file
+itself) and prints, per input:
+
+- the per-round span breakdown (``time_sample/pack/round/eval/agg`` —
+  the reference's scattered manual timers, centralized);
+- comm byte / message / latency tables per message type
+  (``comm.sent_bytes{msg_type=...}`` naming convention);
+- the compile-event timeline (``kind=compile`` records +
+  ``jax.compiles{fn=...}`` counters — a recompile storm shows up as a
+  count climbing with rounds);
+- gauges (device-memory high-water etc.).
+
+``--json`` emits one machine-parseable JSON object so BENCH_* rounds
+can consume the same numbers the human table shows.  Deliberately
+stdlib-only: usable on any checkout with a bare python, no jax import.
+
+Usage:
+    python tools/trace_summary.py runs/fedavg-synthetic-20260803-120000
+    python tools/trace_summary.py --json run_a run_b
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+
+def parse_metric_key(key: str) -> Tuple[str, Dict[str, str]]:
+    """``name{k=v,...}`` → (name, labels) — mirror of obs.telemetry
+    (duplicated so this CLI never needs the package importable)."""
+    if not key.endswith("}") or "{" not in key:
+        return key, {}
+    name, _, inner = key.partition("{")
+    labels = {}
+    for part in inner[:-1].split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return name, labels
+
+
+def load_records(path: str) -> List[dict]:
+    if os.path.isdir(path):
+        path = os.path.join(path, "metrics.jsonl")
+    records = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                records.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # partial last line of a crashed run: skip, keep rest
+    return records
+
+
+def hist_quantile(hist: dict, q: float) -> Optional[float]:
+    """Upper-bound estimate of a quantile from the log2 bucket counts."""
+    count = hist.get("count", 0)
+    if not count:
+        return None
+    buckets = sorted(
+        (float(le), n) for le, n in (hist.get("buckets") or {}).items()
+    )
+    target = q * count
+    seen = 0
+    for le, n in buckets:
+        seen += n
+        if seen >= target:
+            return le
+    return buckets[-1][0] if buckets else None
+
+
+def summarize(records: List[dict]) -> dict:
+    rounds = [r for r in records if "round" in r and "kind" not in r]
+    compiles = [r for r in records if r.get("kind") == "compile"]
+    traces = [r for r in records
+              if r.get("kind") in ("trace", "trace_rounds")]
+    config = next((r for r in records if r.get("kind") == "config"), None)
+    telemetry = None
+    for r in records:
+        if r.get("kind") == "telemetry":
+            telemetry = r  # last snapshot wins (counters are cumulative)
+
+    span_keys = sorted({k for r in rounds for k in r if k.startswith("time_")})
+    spans = {}
+    for k in span_keys:
+        vals = [r[k] for r in rounds if isinstance(r.get(k), (int, float))]
+        if vals:
+            spans[k] = {
+                "count": len(vals),
+                "total_s": sum(vals),
+                "mean_s": sum(vals) / len(vals),
+                "max_s": max(vals),
+            }
+
+    comm: Dict[str, dict] = {}
+    gauges: Dict[str, float] = {}
+    compile_counters: Dict[str, float] = {}
+    if telemetry:
+        for key, value in (telemetry.get("counters") or {}).items():
+            name, labels = parse_metric_key(key)
+            if name.startswith("comm."):
+                row = comm.setdefault(labels.get("msg_type", "?"), {})
+                row[name.split(".", 1)[1]] = value
+            elif name.startswith("jax."):
+                compile_counters[key] = value
+        for key, value in (telemetry.get("gauges") or {}).items():
+            gauges[key] = value
+        for key, hist in (telemetry.get("hists") or {}).items():
+            name, labels = parse_metric_key(key)
+            if name == "comm.send_latency_s":
+                row = comm.setdefault(labels.get("msg_type", "?"), {})
+                row["send_latency"] = {
+                    "count": hist.get("count"),
+                    "mean_s": hist.get("mean"),
+                    "p50_le_s": hist_quantile(hist, 0.5),
+                    "p99_le_s": hist_quantile(hist, 0.99),
+                    "max_s": hist.get("max"),
+                }
+            elif name == "comm.handle_latency_s":
+                row = comm.setdefault(labels.get("msg_type", "?"), {})
+                row["handle_latency"] = {
+                    "count": hist.get("count"),
+                    "mean_s": hist.get("mean"),
+                    "max_s": hist.get("max"),
+                }
+
+    return {
+        "num_records": len(records),
+        "num_rounds": len(rounds),
+        "config": {k: config[k] for k in ("algorithm", "dataset", "model")
+                   if config and k in config} if config else {},
+        "rounds": rounds,
+        "spans": spans,
+        "comm": comm,
+        "compiles": [
+            {k: c.get(k) for k in ("ts", "fn", "signature", "seconds")}
+            for c in compiles
+        ],
+        "compile_counters": compile_counters,
+        "gauges": gauges,
+        "traces": traces,
+    }
+
+
+def _fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return f"{n:,.1f} {unit}" if unit != "B" else f"{int(n):,} B"
+        n /= 1024.0
+    return f"{n:,.1f} GiB"
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    if v >= 1.0:
+        return f"{v:,.2f}s"
+    if v >= 1e-3:
+        return f"{v * 1e3:,.2f}ms"
+    return f"{v * 1e6:,.0f}µs"
+
+
+def render_text(path: str, s: dict, max_round_rows: int = 30) -> None:
+    print(f"== {path} ==")
+    if s["config"]:
+        print("  config: " + ", ".join(f"{k}={v}" for k, v in s["config"].items()))
+    print(f"  records: {s['num_records']}  rounds: {s['num_rounds']}")
+
+    rounds = s["rounds"]
+    span_keys = sorted(s["spans"])
+    if rounds and span_keys:
+        print("\n  per-round spans:")
+        header = "    round  " + "".join(f"{k[5:]:>12}" for k in span_keys)
+        print(header)
+        shown = rounds if len(rounds) <= max_round_rows else (
+            rounds[: max_round_rows // 2] + rounds[-max_round_rows // 2:]
+        )
+        prev_r = None
+        for r in shown:
+            if prev_r is not None and r.get("round", 0) > prev_r + 1:
+                print("    ...")
+            prev_r = r.get("round", 0)
+            cells = "".join(
+                f"{_fmt_s(r.get(k)) if isinstance(r.get(k), (int, float)) else '-':>12}"
+                for k in span_keys
+            )
+            print(f"    {r.get('round', '?'):>5}  {cells}")
+        total = "".join(
+            f"{_fmt_s(s['spans'][k]['total_s']):>12}" for k in span_keys
+        )
+        mean = "".join(
+            f"{_fmt_s(s['spans'][k]['mean_s']):>12}" for k in span_keys
+        )
+        print(f"    total  {total}")
+        print(f"    mean   {mean}")
+
+    if s["comm"]:
+        print("\n  comm (per message type):")
+        print(f"    {'msg_type':<20}{'sent':>8}{'sent_bytes':>14}"
+              f"{'recv':>8}{'recv_bytes':>14}{'send p50':>10}{'send p99':>10}")
+        for mt in sorted(s["comm"]):
+            row = s["comm"][mt]
+            lat = row.get("send_latency") or {}
+            print(
+                f"    {mt:<20}"
+                f"{int(row.get('sent_msgs', 0)):>8}"
+                f"{_fmt_bytes(row.get('sent_bytes', 0)):>14}"
+                f"{int(row.get('recv_msgs', 0)):>8}"
+                f"{_fmt_bytes(row.get('recv_bytes', 0)):>14}"
+                f"{_fmt_s(lat.get('p50_le_s')):>10}"
+                f"{_fmt_s(lat.get('p99_le_s')):>10}"
+            )
+
+    if s["compiles"] or s["compile_counters"]:
+        print("\n  compile events:")
+        for c in s["compiles"]:
+            print(f"    ts={c.get('ts', 0):.3f}  fn={c.get('fn')}  "
+                  f"signature#{c.get('signature')}  {_fmt_s(c.get('seconds'))}")
+        for key in sorted(s["compile_counters"]):
+            print(f"    {key} = {s['compile_counters'][key]:g}")
+
+    if s["gauges"]:
+        print("\n  gauges:")
+        for key in sorted(s["gauges"]):
+            v = s["gauges"][key]
+            shown = _fmt_bytes(v) if "bytes" in key else f"{v:g}"
+            print(f"    {key} = {shown}")
+
+    if s["traces"]:
+        print("\n  profiler traces:")
+        for t in s["traces"]:
+            extra = f"  round_s={t['round_s']}" if "round_s" in t else ""
+            print(f"    {t.get('trace_dir')}{extra}")
+    print()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("inputs", nargs="+",
+                   help="run dir(s) containing metrics.jsonl, or file paths")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="machine-parseable output (one object, keyed by input)")
+    args = p.parse_args(argv)
+
+    out = {}
+    errors = 0
+    for path in args.inputs:
+        try:
+            records = load_records(path)
+        except OSError as e:
+            print(f"error: {path}: {e}", file=sys.stderr)
+            errors += 1
+            continue
+        out[path] = summarize(records)
+
+    if args.as_json:
+        # strict JSON for machine consumers: python's json would emit
+        # bare Infinity/NaN tokens, which most parsers reject
+        def _clean(v):
+            if isinstance(v, dict):
+                return {k: _clean(x) for k, x in v.items()}
+            if isinstance(v, list):
+                return [_clean(x) for x in v]
+            if isinstance(v, float) and not math.isfinite(v):
+                return None
+            return v
+
+        print(json.dumps(_clean(out), default=str))
+    else:
+        for path, s in out.items():
+            render_text(path, s)
+    return 2 if errors else 0  # partial failure is failure (BENCH harnesses)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -77,14 +77,16 @@ def build_north_star(
         bundle = resnet56(num_classes=10)
     else:
         # TPU-retiled EXECUTION variants of the SAME model (identical
-        # params + function, pinned by tests/test_resnet_tpu.py):
-        # s2d1/s2d2/s2d3 = space-to-depth through stages 1..k;
-        # pad32 = stage-1 lane padding
+        # params + function, pinned by tests/test_resnet_tpu.py +
+        # tests/test_conv_mxu.py): s2d1/s2d2/s2d3 = space-to-depth
+        # through stages 1..k; pad32 = stage-1 lane padding; pallas =
+        # implicit-GEMM Pallas 3×3 conv kernel with moment-fused BN
         from fedml_tpu.models.resnet_tpu import resnet56_tpu
 
         kw = {"s2d1": {"s2d_stages": 1}, "s2d2": {"s2d_stages": 2},
               "s2d3": {"s2d_stages": 3},
-              "pad32": {"pad_stage1_to": 32}}[conv_variant]
+              "pad32": {"pad_stage1_to": 32},
+              "pallas": {"conv_variant": "pallas"}}[conv_variant]
         bundle = resnet56_tpu(num_classes=10, **kw)
     opt = make_client_optimizer("sgd", 0.001, momentum=0.9, weight_decay=0.001)
     local_update = make_local_update(
@@ -248,15 +250,18 @@ def main():
     )
     p.add_argument(
         "--conv-variant",
-        choices=["baseline", "s2d1", "s2d2", "s2d3", "pad32"],
+        choices=["baseline", "s2d1", "s2d2", "s2d3", "pad32", "pallas"],
         default="s2d1",
         help="north_star conv execution variant (models/resnet_tpu.py): "
         "same model/params/function (parity-tested), retiled for MXU "
         "lanes — s2dK folds 2x2 spatial blocks into channels through "
-        "stage K; pad32 zero-pads stage-1's 16-wide convs to 32 lanes. "
-        "r5 sweep on v5e (samples/s): baseline 28,828; s2d1 29,897 "
-        "(default — +3.7%); s2d2 26,909; s2d3 22,370; pad32 24,673 — "
-        "see PROFILE.md for the tile math behind each",
+        "stage K; pad32 zero-pads stage-1's 16-wide convs to 32 lanes; "
+        "pallas runs every 3x3 conv as an implicit-GEMM Pallas kernel "
+        "(ops/conv_mxu: [M, 9*Cin] patch matrix, one MXU matmul, "
+        "moment-fused train BN). r5 sweep on v5e (samples/s): baseline "
+        "28,828; s2d1 29,897 (default — +3.7%); s2d2 26,909; s2d3 "
+        "22,370; pad32 24,673 — see PROFILE.md for the tile math; the "
+        "pallas variant's chip sweep is the PROFILE.md round-6 item",
     )
     p.add_argument("--seq-len", type=int, default=1024)
     p.add_argument("--embed-dim", type=int, default=1280,

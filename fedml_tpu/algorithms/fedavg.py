@@ -175,16 +175,38 @@ def make_round_fn(
         )
         return new_state, train_metrics
 
+    # the baked-in mesh axis travels WITH the kernel: a pre-built
+    # shard_map kernel handed to a fused driver must still trip the
+    # on-device-subsampling guard (ADVICE r5 — round_kw is empty there,
+    # so the kwarg-based check alone cannot fire)
+    round_fn.axis_name = axis_name
     return round_fn
 
 
-def _resolve_round_fn(local_update, round_fn, round_kw):
+def _resolve_round_fn(local_update, round_fn, round_kw,
+                      on_device_sampling: bool = False):
     """Shared by both fused drivers: ``round_fn`` is a PRE-BUILT round
     kernel (the ``_build_round_fn`` subclass hook — FedNova's
     normalized aggregation etc.); the fused scans are kernel-agnostic,
     so any same-signature kernel fuses (VERDICT r4 weak #6: the fused
     fast paths used to refuse exactly the algorithms that need long
-    runs).  Kernel-shaping kwargs must already be baked into it."""
+    runs).  Kernel-shaping kwargs must already be baked into it.
+
+    ``on_device_sampling`` marks a caller that draws per-round
+    participation masks on device (clients_per_round / drop_prob):
+    under shard_map each device sees only its local client block, so
+    such a draw would silently be per-device-local — the guard reads
+    the axis_name either from ``round_kw`` or from the tag
+    ``make_round_fn`` stamps on the kernel it returns."""
+    baked_axis = round_kw.get("axis_name") or getattr(
+        round_fn, "axis_name", None
+    )
+    if on_device_sampling and baked_axis:
+        raise ValueError(
+            "on-device clients_per_round/drop_prob are not defined under "
+            f"shard_map (axis_name={baked_axis!r}: local block != global "
+            "client axis); pass per-round masks from the host instead"
+        )
     if round_fn is not None:
         if round_kw:
             raise ValueError(
@@ -244,14 +266,10 @@ def make_multi_round_fn(
             f"clients_per_round must be >= 1, got {clients_per_round} "
             "(0 would zero every round's weighted average)"
         )
-    if round_kw.get("axis_name") and (
-        clients_per_round is not None or drop_prob
-    ):
-        raise ValueError(
-            "on-device clients_per_round/drop_prob are not defined under "
-            "shard_map (local block != global client axis)"
-        )
-    rf = _resolve_round_fn(local_update, round_fn, round_kw)
+    rf = _resolve_round_fn(
+        local_update, round_fn, round_kw,
+        on_device_sampling=clients_per_round is not None or bool(drop_prob),
+    )
 
     def multi_round_fn(
         state: ServerState, x, y, mask, num_samples, participation, slot_ids
@@ -311,7 +329,11 @@ def make_scheduled_multi_round_fn(
     """
     from fedml_tpu.core.sampling import inject_dropout
 
-    rf = _resolve_round_fn(local_update, round_fn, round_kw)
+    # drop_prob here draws from a host-replicated key per LOCAL slot —
+    # the same per-device-local-mask hazard as the resident driver's
+    # on-device subsampling, so the same guard applies
+    rf = _resolve_round_fn(local_update, round_fn, round_kw,
+                           on_device_sampling=bool(drop_prob))
 
     def scheduled_fn(
         state: ServerState, x, y, mask, num_samples, participation, slot_ids
@@ -440,8 +462,13 @@ class FedAvgSimulation:
         self.steps_per_epoch = cohort_steps_per_epoch(
             dataset, config.batch_size
         )
-        self._test_pack = batch_eval_pack(
-            dataset.test_x, dataset.test_y, max(config.batch_size, 64)
+        # datasets without a held-out split (stackoverflow real-h5
+        # missing *_test.h5) still TRAIN; the refusal happens at eval
+        # time with batch_eval_pack's actionable message
+        self._test_pack = (
+            None if dataset.test_x is None else batch_eval_pack(
+                dataset.test_x, dataset.test_y, max(config.batch_size, 64)
+            )
         )
         self.history = []
         # (cohort key, device-resident packed block) — see _device_pack
@@ -572,6 +599,10 @@ class FedAvgSimulation:
         return out
 
     def evaluate_global(self) -> dict:
+        if self._test_pack is None:
+            # same refusal (and message) the pack itself raises —
+            # deferred here so training-only use never hits it
+            batch_eval_pack(self.dataset.test_x, self.dataset.test_y, 64)
         x, y, m = self._test_pack
         res = self.evaluator(
             self.state.variables, jnp.asarray(x), jnp.asarray(y), jnp.asarray(m)

@@ -149,6 +149,9 @@ def make_fednova_round_fn(
         )
         return new_state, train_metrics
 
+    # same tag make_round_fn stamps: the fused drivers' shard_map ×
+    # on-device-subsampling guard reads it off pre-built kernels
+    round_fn.axis_name = axis_name
     return round_fn
 
 

@@ -54,7 +54,11 @@ class FedDataset:
 
     @property
     def test_data_num(self) -> int:
-        return int(self.test_x.shape[0])
+        # 0 when the dataset ships no held-out split (test arrays None
+        # — e.g. stackoverflow real-h5 without *_test.h5); evaluation
+        # itself is refused with an actionable message in
+        # batch_eval_pack
+        return 0 if self.test_x is None else int(self.test_x.shape[0])
 
     def client_sample_counts(self) -> np.ndarray:
         """[num_clients] number of training samples per client."""
@@ -122,6 +126,11 @@ class FedDataset:
         ``train_data_global``/locals are lists of (x, y) numpy batches, the
         shape the reference's torch DataLoaders would yield.
         """
+        if self.test_x is None or self.test_y is None:
+            # same actionable refusal as batch_eval_pack — the 8-tuple
+            # has test slots, so a no-test-split dataset can't fill it
+            batch_eval_pack(self.test_x, self.test_y, batch_size)
+
         def batches(x, y):
             return [
                 (x[i : i + batch_size], y[i : i + batch_size])
@@ -350,6 +359,16 @@ def batch_eval_pack(
 
     Returns (x_batched [steps, B, ...], y_batched [steps, B], mask).
     """
+    if x is None or y is None:
+        # loaders return None test arrays when a dataset ships no
+        # held-out split (e.g. stackoverflow real-h5 without
+        # *_test.h5) — refuse with the actionable message instead of
+        # an opaque len(None) deep in driver construction
+        raise ValueError(
+            "dataset has no test split (test arrays are None): fetch "
+            "the *_test.h5 file or evaluate on a dataset that ships "
+            "one — evaluating on training data is not a fallback"
+        )
     n = len(x)
     steps = max(1, int(np.ceil(n / batch_size)))
     total = steps * batch_size

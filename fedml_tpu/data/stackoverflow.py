@@ -108,6 +108,32 @@ def nwp_chain_ceiling(eta: float, vocab: int = NWP_VOCAB) -> float:
     return (1.0 - eta) + eta / vocab
 
 
+def _parse_nwp_h5(path: str, num_clients: int):
+    """Windows + per-client index from one TFF-layout h5 (``examples/
+    <client>/tokens``) — shared by the train and test splits."""
+    import h5py
+
+    xs, ys, idx = [], [], {}
+    off = 0
+    with h5py.File(path, "r") as f:
+        ex = f["examples"]
+        for c, cid in enumerate(sorted(ex.keys())[: num_clients or None]):
+            toks = np.asarray(ex[cid]["tokens"])  # already int windows
+            kept = 0
+            for row in toks:
+                row = np.asarray(row, np.int32)[: NWP_SEQ_LEN + 1]
+                if len(row) < 2:
+                    continue
+                pad = NWP_SEQ_LEN + 1 - len(row)
+                row = np.pad(row, (0, pad))
+                xs.append(row[:-1])
+                ys.append(row[1:])
+                kept += 1
+            idx[c] = np.arange(off, off + kept)
+            off += kept
+    return xs, ys, idx
+
+
 def load_stackoverflow_nwp(
     data_dir: str = "./data/stackoverflow/datasets",
     num_clients: int = 10,
@@ -120,31 +146,24 @@ def load_stackoverflow_nwp(
     h5path = os.path.join(data_dir, "stackoverflow_nwp.pkl")
     tr = os.path.join(data_dir, "stackoverflow_train.h5")
     if os.path.exists(tr):
-        import h5py
-
-        xs, ys, idx = [], [], {}
-        off = 0
-        with h5py.File(tr, "r") as f:
-            ex = f["examples"]
-            for c, cid in enumerate(sorted(ex.keys())[: num_clients or None]):
-                toks = np.asarray(ex[cid]["tokens"])  # already int windows
-                kept = 0
-                for row in toks:
-                    row = np.asarray(row, np.int32)[: NWP_SEQ_LEN + 1]
-                    if len(row) < 2:
-                        continue
-                    pad = NWP_SEQ_LEN + 1 - len(row)
-                    row = np.pad(row, (0, pad))
-                    xs.append(row[:-1])
-                    ys.append(row[1:])
-                    kept += 1
-                idx[c] = np.arange(off, off + kept)
-                off += kept
+        xs, ys, idx = _parse_nwp_h5(tr, num_clients)
+        # the reference evaluates on the SEPARATE held-out split
+        # (stackoverflow_test.h5); evaluating on the first 64 training
+        # windows would silently report train accuracy as test accuracy
+        # (ADVICE r5) — with no test file present the test arrays are
+        # None so any eval attempt fails loudly instead
+        te = os.path.join(data_dir, "stackoverflow_test.h5")
+        test_x = test_y = None
+        if os.path.exists(te):
+            txs, tys, _ = _parse_nwp_h5(te, num_clients)
+            if txs:  # an empty/unusable split stays None (same refusal)
+                test_x = np.stack(txs).astype(np.int32)
+                test_y = np.stack(tys).astype(np.int32)
         return FedDataset(
             train_x=np.stack(xs).astype(np.int32),
             train_y=np.stack(ys).astype(np.int32),
-            test_x=np.stack(xs[:64]).astype(np.int32),
-            test_y=np.stack(ys[:64]).astype(np.int32),
+            test_x=test_x,
+            test_y=test_y,
             train_client_idx=idx, test_client_idx=None,
             num_classes=NWP_EXTENDED, name="stackoverflow_nwp",
         )
@@ -247,8 +266,17 @@ def load_stackoverflow_lr(
                 int(c): np.asarray(v)
                 for c, v in enumerate(np.asarray(f["client_ptr"]))
             }
+        # held-out split only (ADVICE r5: the first-64-training-rows
+        # fallback was eval-on-train); None test arrays make an eval
+        # without the real test h5 fail loudly
+        te = os.path.join(data_dir, "stackoverflow_lr_test.h5")
+        test_x = test_y = None
+        if os.path.exists(te):
+            with h5py.File(te, "r") as f:
+                test_x = np.asarray(f["x"], np.float32)
+                test_y = np.asarray(f["y"], np.float32)
         return FedDataset(
-            train_x=x, train_y=y, test_x=x[:64], test_y=y[:64],
+            train_x=x, train_y=y, test_x=test_x, test_y=test_y,
             train_client_idx=idx, test_client_idx=None,
             num_classes=num_tags, name="stackoverflow_lr",
         )

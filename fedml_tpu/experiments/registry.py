@@ -123,7 +123,8 @@ def shrink_dataset(
         }
     test_x, test_y = ds.test_x, ds.test_y
     test_idx = ds.test_client_idx
-    if max_test_samples and len(test_y) > max_test_samples:
+    if max_test_samples and test_y is not None and \
+            len(test_y) > max_test_samples:
         # deterministic STRIDED selection, not a prefix: folder-tree
         # loaders (imagefolder/CINIC) emit test arrays grouped by class,
         # so a [:N] prefix collapses the smoke test set to one or two

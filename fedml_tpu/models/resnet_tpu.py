@@ -24,8 +24,17 @@ variables apply directly):
   bottleneck convs execute at width p with zero-padded kernels; the
   padded channels provably stay zero through conv→BN→relu, so the
   function is unchanged.
+- **Pallas implicit GEMM** (``conv_variant="pallas"``): every 3×3 conv
+  runs through ``ops/conv_mxu.conv3x3`` — patches gathered in VMEM
+  into an [N·Ho·Wo, 9·Cin] matrix and multiplied in ONE MXU matmul,
+  attacking M/K packing instead of the lane axis the dense retilings
+  above inflated (r5 measured them all negative).  In train mode the
+  kernel additionally emits the per-channel activation moments, so the
+  following BatchNorm's batch statistics cost no separate full-tensor
+  ``reduce_sum`` pass.  1×1 convs stay on XLA (they already lower to
+  dense GEMMs; there is no patch axis to pack).
 
-Both transforms are parameter-preserving: the variables are created
+All transforms are parameter-preserving: the variables are created
 with the baseline's exact names and shapes (``Conv_i/kernel`` etc.),
 and kernels are expanded inside the forward, so gradients flow to the
 original parameters and FedAvg aggregation/checkpoints are unchanged.
@@ -126,7 +135,10 @@ class _XConv(nn.Module):
     {"n", "s"} (normal / space-to-depth); ``pad_to`` zero-pads the
     compute width (lane padding) — ``pad_in`` declares how many of the
     input's trailing channels are structural zeros (so the kernel rows
-    feeding them can be zero)."""
+    feeding them can be zero).  ``conv_variant="pallas"`` routes 3×3
+    normal-space convs through the implicit-GEMM Pallas kernel
+    (``ops/conv_mxu``); with ``emit_moments`` the call returns
+    ``(y, (sum, sumsq, count))`` for moment-fused BatchNorm."""
 
     features: int
     in_features: int
@@ -136,6 +148,8 @@ class _XConv(nn.Module):
     out_space: str = "n"
     pad_to: int = 0
     pad_in: int = 0
+    conv_variant: str = "xla"
+    emit_moments: bool = False
 
     @nn.compact
     def __call__(self, x):
@@ -145,6 +159,24 @@ class _XConv(nn.Module):
             jnp.float32,
         )
         w = w.astype(x.dtype)
+        if self.conv_variant == "pallas" and k == 3:
+            if (self.in_space, self.out_space) != ("n", "n") or \
+                    self.pad_to or self.pad_in:
+                raise ValueError(
+                    "conv_variant='pallas' composes with neither s2d "
+                    "spaces nor lane padding (r5 measured those dense "
+                    "retilings negative; the kernel runs normal-space)"
+                )
+            from fedml_tpu.ops.conv_mxu import conv3x3, conv3x3_moments
+
+            if self.emit_moments:
+                y, s, sq = conv3x3_moments(x, w, self.stride)
+                count = float(
+                    x.shape[0] * (x.shape[1] // self.stride)
+                    * (x.shape[2] // self.stride)
+                )
+                return y, (s, sq, count)
+            return conv3x3(x, w, self.stride)
         if self.pad_to or self.pad_in:
             w = jnp.pad(w, ((0, 0), (0, 0), (0, self.pad_in),
                             (0, (self.pad_to - co) if self.pad_to else 0)))
@@ -197,7 +229,11 @@ class _XBatchNorm(nn.Module):
     epsilon: float = 1e-5
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, moments=None):
+        """``moments=(sum, sumsq, count)`` supplies the batch statistics
+        pre-reduced (the Pallas conv kernel's fused moment outputs) so
+        the train path skips its own full-tensor reductions; only valid
+        in normal space with no lane padding."""
         c = self.channels
         scale = self.param("scale", nn.initializers.ones, (c,), jnp.float32)
         bias = self.param("bias", nn.initializers.zeros, (c,), jnp.float32)
@@ -207,8 +243,15 @@ class _XBatchNorm(nn.Module):
         ra_var = self.variable(
             "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
         )
+        if moments is not None and (self.space != "n" or self.pad_to):
+            raise ValueError("pre-reduced moments need normal space "
+                             "and no lane padding")
         if train:
-            if self.space == "s":
+            if moments is not None:
+                s, sq, count = moments
+                mean = s / count
+                mean2 = sq / count
+            elif self.space == "s":
                 xr = x.reshape(x.shape[:3] + (4, c)).astype(jnp.float32)
                 mean = jnp.mean(xr, axis=(0, 1, 2, 3))
                 mean2 = jnp.mean(jnp.square(xr), axis=(0, 1, 2, 3))
@@ -253,6 +296,7 @@ class BottleneckTPU(nn.Module):
     out_space: str = "n"
     pad_to: int = 0   # compute width for the internal `planes` convs
     pad_in: int = 0   # structural-zero channels on the block INPUT
+    conv_variant: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -260,27 +304,34 @@ class BottleneckTPU(nn.Module):
         mid_space = self.in_space  # 1x1 reduce keeps the input space
         y = _XConv(planes, self.in_ch, 1, 1, self.in_space, mid_space,
                    pad_to=self.pad_to, pad_in=self.pad_in,
-                   name="Conv_0")(x)
+                   conv_variant=self.conv_variant, name="Conv_0")(x)
         y = _XBatchNorm(planes, mid_space, pad_to=self.pad_to,
                         name="BatchNorm_0")(y, train)
         y = nn.relu(y)
+        # the 3×3 is the Pallas target; in train mode its kernel also
+        # emits the activation moments the next BatchNorm consumes
+        fuse_moments = self.conv_variant == "pallas" and train
         y = _XConv(planes, planes, 3, self.stride, mid_space,
                    self.out_space, pad_to=self.pad_to,
                    pad_in=(self.pad_to - planes if self.pad_to else 0),
-                   name="Conv_1")(y)
+                   conv_variant=self.conv_variant,
+                   emit_moments=fuse_moments, name="Conv_1")(y)
+        y, mom = y if fuse_moments else (y, None)
         post_space = mid_space if self.stride == 1 else self.out_space
         y = _XBatchNorm(planes, post_space, pad_to=self.pad_to,
-                        name="BatchNorm_1")(y, train)
+                        name="BatchNorm_1")(y, train, moments=mom)
         y = nn.relu(y)
         y = _XConv(out_ch, planes, 1, 1, post_space, post_space,
                    pad_in=(self.pad_to - planes if self.pad_to else 0),
-                   name="Conv_2")(y)
+                   conv_variant=self.conv_variant, name="Conv_2")(y)
         y = _XBatchNorm(out_ch, post_space, name="BatchNorm_2")(y, train)
         identity = x
         if self.in_ch != out_ch or self.stride != 1:
             identity = _XConv(out_ch, self.in_ch, 1, self.stride,
                               self.in_space, self.out_space,
-                              pad_in=self.pad_in, name="Conv_3")(x)
+                              pad_in=self.pad_in,
+                              conv_variant=self.conv_variant,
+                              name="Conv_3")(x)
             sc_space = self.in_space if self.stride == 1 else self.out_space
             identity = _XBatchNorm(out_ch, sc_space,
                                    name="BatchNorm_3")(identity, train)
@@ -297,6 +348,7 @@ class CifarResNetTPU(nn.Module):
     num_classes: int = 10
     s2d_stages: int = 0
     pad_stage1_to: int = 0
+    conv_variant: str = "xla"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -305,11 +357,24 @@ class CifarResNetTPU(nn.Module):
             # the transforms would need a pad-aware s2d BatchNorm for
             # no additional lane win
             raise ValueError("s2d_stages and pad_stage1_to are exclusive")
+        if self.conv_variant == "pallas" and (
+            self.s2d_stages or self.pad_stage1_to
+        ):
+            # the implicit-GEMM kernel runs normal-space; the dense
+            # retilings it replaces were each measured negative (r5)
+            raise ValueError(
+                "conv_variant='pallas' excludes s2d_stages/pad_stage1_to"
+            )
         spaces = ["s" if s < self.s2d_stages else "n" for s in range(3)]
         if self.s2d_stages > 0:
             x = space_to_depth(x)
-        x = _XConv(16, 3, 3, 1, spaces[0], spaces[0], name="Conv_0")(x)
-        x = _XBatchNorm(16, spaces[0], name="BatchNorm_0")(x, train)
+        fuse_moments = self.conv_variant == "pallas" and train
+        x = _XConv(16, 3, 3, 1, spaces[0], spaces[0],
+                   conv_variant=self.conv_variant,
+                   emit_moments=fuse_moments, name="Conv_0")(x)
+        x, mom = x if fuse_moments else (x, None)
+        x = _XBatchNorm(16, spaces[0], name="BatchNorm_0")(
+            x, train, moments=mom)
         x = nn.relu(x)
         in_ch, j = 16, 0
         for stage, (planes, n_blocks) in enumerate(
@@ -324,7 +389,8 @@ class CifarResNetTPU(nn.Module):
                 x = BottleneckTPU(
                     planes=planes, in_ch=in_ch, stride=stride,
                     in_space=in_space, out_space=spaces[stage],
-                    pad_to=pad, name=f"Bottleneck_{j}",
+                    pad_to=pad, conv_variant=self.conv_variant,
+                    name=f"Bottleneck_{j}",
                 )(x, train)
                 in_ch, j = planes * 4, j + 1
         if spaces[2] == "s":
@@ -336,15 +402,19 @@ class CifarResNetTPU(nn.Module):
 
 
 def resnet56_tpu(num_classes: int = 10, image_size: int = 32,
-                 s2d_stages: int = 0, pad_stage1_to: int = 0) -> ModelBundle:
+                 s2d_stages: int = 0, pad_stage1_to: int = 0,
+                 conv_variant: str = "xla") -> ModelBundle:
     """ResNet-56 (reference factory parity: Bottleneck [6,6,6]) with
-    TPU execution transforms.  ``s2d_stages=0, pad_stage1_to=0`` is
-    bit-for-bit the baseline architecture (and still asserts tree
-    parity in tests)."""
+    TPU execution transforms.  ``s2d_stages=0, pad_stage1_to=0,
+    conv_variant="xla"`` is bit-for-bit the baseline architecture (and
+    still asserts tree parity in tests); ``conv_variant="pallas"`` runs
+    every 3×3 conv through the implicit-GEMM Pallas kernel
+    (``ops/conv_mxu``) with moment-fused train-mode BatchNorm."""
     return ModelBundle(
         module=CifarResNetTPU(
             layers=(6, 6, 6), num_classes=num_classes,
             s2d_stages=s2d_stages, pad_stage1_to=pad_stage1_to,
+            conv_variant=conv_variant,
         ),
         input_shape=(image_size, image_size, 3),
     )

@@ -1,0 +1,192 @@
+"""Interpret-mode parity pins for the implicit-GEMM Pallas conv kernel
+(``ops/conv_mxu``): forward and custom_vjp must match the XLA conv
+baseline for every 3×3 stage shape ResNet-56 uses (stem + three stage
+widths + both stride-2 transitions), in fp32 and bf16, and the
+``conv_variant="pallas"`` execution variant of the full model must be
+function-identical to the baseline module (the
+``tests/test_resnet_tpu.py`` contract)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fedml_tpu.ops.conv_mxu import (
+    _pick_block_n,
+    _xla_conv3x3,
+    conv3x3,
+    conv3x3_moments,
+    conv3x3_mxu,
+)
+
+# (spatial, Cin, Cout, stride) — every 3×3 conv family in ResNet-56:
+# stem 3→16@32, stage-1 16→16@32, the 32/64-wide stage bodies, and both
+# stride-2 stage transitions.  Spatial dims are halved vs the real
+# 32/16/8 maps to keep CPU interpret time sane; channel widths — the
+# quantity the kernel exists for — are the real ones.
+STAGE_SHAPES = [
+    (16, 3, 16, 1),    # stem
+    (16, 16, 16, 1),   # stage 1 body
+    (16, 32, 32, 2),   # stage 1→2 transition
+    (8, 32, 32, 1),    # stage 2 body
+    (8, 64, 64, 2),    # stage 2→3 transition
+    (4, 64, 64, 1),    # stage 3 body
+]
+
+DTYPES = [jnp.float32, jnp.bfloat16]
+
+
+def _tols(dtype, grad=False):
+    if dtype == jnp.bfloat16:
+        return {"rtol": 5e-2, "atol": 5e-2}
+    return {"rtol": 5e-4, "atol": 5e-4} if grad else {"rtol": 1e-5,
+                                                     "atol": 1e-5}
+
+
+def _inputs(hw, ci, co, dtype, n=2):
+    kx, kw_ = jax.random.split(jax.random.PRNGKey(hw * ci + co))
+    x = jax.random.normal(kx, (n, hw, hw, ci), dtype)
+    w = (jax.random.normal(kw_, (3, 3, ci, co), jnp.float32)
+         * 0.2).astype(dtype)
+    return x, w
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("hw,ci,co,stride", STAGE_SHAPES)
+def test_forward_matches_xla(hw, ci, co, stride, dtype):
+    x, w = _inputs(hw, ci, co, dtype)
+    got = conv3x3(x, w, stride)
+    ref = _xla_conv3x3(x, w, stride)
+    assert got.shape == ref.shape == (2, hw // stride, hw // stride, co)
+    assert got.dtype == ref.dtype
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32),
+        **_tols(dtype),
+    )
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("hw,ci,co,stride", STAGE_SHAPES)
+def test_vjp_matches_xla(hw, ci, co, stride, dtype):
+    """dgrad AND wgrad of a non-trivial scalar loss through the
+    custom_vjp vs the same loss through the XLA conv."""
+    x, w = _inputs(hw, ci, co, dtype)
+    cot = jax.random.normal(
+        jax.random.PRNGKey(3), (2, hw // stride, hw // stride, co)
+    )
+
+    def loss(conv):
+        def f(x_, w_):
+            y = conv(x_, w_, stride).astype(jnp.float32)
+            return (y * cot).sum() + (y * y).sum() * 0.1
+        return f
+
+    gx, gw = jax.grad(loss(conv3x3), argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss(_xla_conv3x3), argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(rx, np.float32),
+                               **_tols(dtype, grad=True))
+    np.testing.assert_allclose(np.asarray(gw, np.float32),
+                               np.asarray(rw, np.float32),
+                               **_tols(dtype, grad=True))
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+@pytest.mark.parametrize("hw,ci,co,stride",
+                         [(16, 16, 16, 1), (8, 32, 32, 2)])
+def test_moments_forward_and_vjp(hw, ci, co, stride, dtype):
+    """The fused moment outputs equal the full-tensor reductions of the
+    emitted activations, and their COTANGENTS flow (the BN mean/var
+    gradient path) exactly as through the XLA reference."""
+    x, w = _inputs(hw, ci, co, dtype)
+    y, s, sq = conv3x3_moments(x, w, stride)
+    yf = np.asarray(y, np.float32)
+    np.testing.assert_allclose(np.asarray(s), yf.sum((0, 1, 2)),
+                               rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(np.asarray(sq), (yf * yf).sum((0, 1, 2)),
+                               rtol=1e-4, atol=1e-3)
+
+    ds = jnp.linspace(0.5, 1.5, co)
+    dsq = jnp.linspace(-0.5, 0.5, co)
+
+    def loss_pallas(x_, w_):
+        y_, s_, sq_ = conv3x3_moments(x_, w_, stride)
+        return (y_.astype(jnp.float32).sum()
+                + (s_ * ds).sum() + (sq_ * dsq).sum())
+
+    def loss_ref(x_, w_):
+        yf_ = _xla_conv3x3(x_, w_, stride).astype(jnp.float32)
+        return (yf_.sum() + (yf_.sum((0, 1, 2)) * ds).sum()
+                + ((yf_ * yf_).sum((0, 1, 2)) * dsq).sum())
+
+    gx, gw = jax.grad(loss_pallas, argnums=(0, 1))(x, w)
+    rx, rw = jax.grad(loss_ref, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(np.asarray(gx, np.float32),
+                               np.asarray(rx, np.float32),
+                               **_tols(dtype, grad=True))
+    np.testing.assert_allclose(np.asarray(gw, np.float32),
+                               np.asarray(rw, np.float32),
+                               **_tols(dtype, grad=True))
+
+
+def test_fused_affine_relu_epilogue():
+    """mul/add/relu fuse the BN-affine eval form into the matmul
+    epilogue: out == relu(conv(x, w) * mul + add)."""
+    x, w = _inputs(8, 16, 32, jnp.float32)
+    mul = jnp.linspace(0.5, 1.5, 32)
+    add = jnp.linspace(-0.3, 0.3, 32)
+    got = conv3x3_mxu(x, w, stride=1, mul=mul, add=add, relu=True)
+    ref = jnp.maximum(_xla_conv3x3(x, w, 1) * mul + add, 0.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_block_n_packs_small_maps():
+    """Stage-3-sized maps (8×8 = 64 GEMM rows/image) pack multiple
+    images per kernel invocation toward the 512-row M target; stage-1
+    maps (1024 rows) stay single-image."""
+    assert _pick_block_n(64, 32 * 32) == 1
+    assert _pick_block_n(64, 8 * 8) == 8
+    assert _pick_block_n(2, 8 * 8) == 2       # capped by the batch
+    # block_n always divides the batch
+    for n in (2, 6, 64):
+        bn = _pick_block_n(n, 16)
+        assert n % bn == 0
+
+
+def test_input_validation():
+    x = jnp.zeros((2, 8, 8, 16))
+    with pytest.raises(ValueError):
+        conv3x3_mxu(x, jnp.zeros((1, 1, 16, 16)))        # not 3x3
+    with pytest.raises(ValueError):
+        conv3x3_mxu(x, jnp.zeros((3, 3, 8, 16)))         # Cin mismatch
+    with pytest.raises(ValueError):
+        conv3x3_mxu(x, jnp.zeros((3, 3, 16, 16)), stride=3)
+
+
+def test_conv3x3_under_vmap_and_scan():
+    """The op must compose with the round-kernel machinery: a lax.scan
+    over steps and vmap over a client axis (the shard_map/vmap client
+    paths), with gradients."""
+    x = jax.random.normal(jax.random.PRNGKey(0), (3, 2, 8, 8, 16))
+    w = jax.random.normal(jax.random.PRNGKey(1), (3, 3, 16, 16)) * 0.2
+
+    def step_loss(w_, xb):
+        return (conv3x3(xb, w_, 1).astype(jnp.float32) ** 2).mean()
+
+    def client_loss(w_, xc):
+        total, _ = jax.lax.scan(
+            lambda c, xb: (c + step_loss(w_, xb), None), 0.0, xc[None]
+        )
+        return total
+
+    got = jax.grad(
+        lambda w_: jax.vmap(lambda xc: client_loss(w_, xc))(x).sum()
+    )(w)
+    ref = jax.grad(
+        lambda w_: sum(
+            (_xla_conv3x3(x[i], w_, 1) ** 2).mean() for i in range(3)
+        )
+    )(w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)

@@ -347,6 +347,25 @@ def test_stackoverflow_nwp_h5(tmp_path):
     assert ds.train_y.shape == (6, NWP_SEQ_LEN)
     np.testing.assert_array_equal(ds.train_x[0, 1:], ds.train_y[0, :-1])
     assert len(ds.train_client_idx) == 2
+    # no stackoverflow_test.h5 → test arrays are None: eval-on-train
+    # must fail loudly instead of silently scoring training windows
+    # (ADVICE r5) — the eval pack refuses with an actionable message
+    assert ds.test_x is None and ds.test_y is None
+    import pytest
+
+    from fedml_tpu.core.types import batch_eval_pack
+
+    with pytest.raises(ValueError, match="no test split"):
+        batch_eval_pack(ds.test_x, ds.test_y, 64)
+
+    # with the held-out split present, test comes from THAT file
+    with h5py.File(tmp_path / "stackoverflow_test.h5", "w") as f:
+        ex = f.create_group("examples")
+        ex.create_group("u9").create_dataset(
+            "tokens", data=rng.randint(1, 100, (2, NWP_SEQ_LEN + 1)))
+    ds = load_stackoverflow_nwp(str(tmp_path), num_clients=2)
+    assert ds.test_x.shape == (2, NWP_SEQ_LEN)
+    assert not np.array_equal(ds.test_x, ds.train_x[:2])
 
 
 def test_stackoverflow_lr_h5(tmp_path):
@@ -363,6 +382,15 @@ def test_stackoverflow_lr_h5(tmp_path):
     assert ds.train_x.shape == (8, 50)
     assert ds.train_y.shape == (8, 5)
     np.testing.assert_array_equal(ds.train_client_idx[1], np.arange(4, 8))
+    # held-out split only (ADVICE r5): no test h5 → None, never train rows
+    assert ds.test_x is None and ds.test_y is None
+
+    with h5py.File(tmp_path / "stackoverflow_lr_test.h5", "w") as f:
+        f.create_dataset("x", data=rng.rand(3, 50))
+        f.create_dataset("y", data=(rng.rand(3, 5) > 0.7).astype(np.float32))
+    ds = load_stackoverflow_lr(str(tmp_path), num_tags=5)
+    assert ds.test_x.shape == (3, 50)
+    assert not np.array_equal(ds.test_x, ds.train_x[:3])
 
 
 # ---------- ImageNet / Landmarks ----------

@@ -529,3 +529,47 @@ def test_run_fused_checkpoint_resume(tmp_path):
     ref_evals = [h["round"] for h in ref.history if "test_acc" in h]
     b_evals = [h["round"] for h in b.history if "test_acc" in h]
     assert [r for r in ref_evals if r >= 3] == b_evals
+
+
+def test_prebuilt_shard_map_kernel_refuses_on_device_sampling():
+    """ADVICE r5: make_round_fn tags its kernel with the baked-in
+    axis_name; a pre-built shard_map kernel handed to a fused driver
+    together with on-device subsampling/dropout must raise — under
+    shard_map each device sees only its local client block, so the
+    draw would silently be per-device-local."""
+    from fedml_tpu.algorithms.fedavg import (
+        make_multi_round_fn, make_round_fn, make_scheduled_multi_round_fn,
+    )
+    from fedml_tpu.core.client import make_client_optimizer, make_local_update
+
+    bundle = logistic_regression(16, 4)
+    lu = make_local_update(bundle, make_client_optimizer("sgd", 0.1), epochs=1)
+
+    plain = make_round_fn(lu)
+    assert plain.axis_name is None
+    sharded = make_round_fn(lu, axis_name="clients")
+    assert sharded.axis_name == "clients"
+    # every pre-built kernel family carries the tag, not just FedAvg's
+    from fedml_tpu.algorithms.fednova import make_fednova_round_fn
+
+    nova = make_fednova_round_fn(lu, lr=0.1, momentum=0.0,
+                                 axis_name="clients")
+    assert nova.axis_name == "clients"
+
+    # the sharded kernel still fuses fine WITHOUT on-device sampling
+    make_multi_round_fn(None, 2, round_fn=sharded)
+    # ... and the plain kernel still takes on-device sampling
+    make_multi_round_fn(None, 2, clients_per_round=2, round_fn=plain)
+
+    with pytest.raises(ValueError, match="shard_map"):
+        make_multi_round_fn(None, 2, clients_per_round=2, round_fn=sharded)
+    with pytest.raises(ValueError, match="shard_map"):
+        make_multi_round_fn(None, 2, drop_prob=0.5, round_fn=sharded)
+    # kwarg-built path keeps the original guard through the same check
+    with pytest.raises(ValueError, match="shard_map"):
+        make_multi_round_fn(lu, 2, clients_per_round=2, axis_name="clients")
+    # scheduled driver: its host-keyed dropout has the same local-block
+    # hazard
+    with pytest.raises(ValueError, match="shard_map"):
+        make_scheduled_multi_round_fn(None, drop_prob=0.5, round_fn=sharded)
+    make_scheduled_multi_round_fn(None, round_fn=sharded)

@@ -147,3 +147,97 @@ def test_hlo_allreduce_bytes_pin_scaling_volume():
     assert parsed["all-reduce"] == 10 * 4 + 4 * 2
     assert parsed["all-gather"] == 16 * 8 * 4
     assert parsed["n_ops"] == 2
+
+
+def test_build_comparison_truncated_arm():
+    """ADVICE r5: arms at different horizons (the c100 noniid arm
+    stopped at round 53 vs iid's 100) must be compared at the common
+    min horizon and carry the truncation caveat, not silently compare
+    final-vs-final across mismatched training budgets."""
+    from convergence_run import build_comparison
+
+    def run(rounds, accs, rtt=None):
+        return {"final_test_acc": accs[-1], "rounds_to_target": rtt,
+                "trajectory": [{"round": r, "test_acc": a,
+                                "test_loss": 1.0}
+                               for r, a in zip(rounds, accs)]}
+
+    # matched horizons: plain comparison, no truncation keys
+    cmp_full = build_comparison({
+        "iid": run([50, 99], [0.8, 0.9], rtt=50),
+        "noniid_lda0.5": run([50, 99], [0.7, 0.85], rtt=99),
+    })
+    assert cmp_full["final_acc_gap_iid_minus_noniid"] == 0.05
+    assert "truncated_arm" not in cmp_full
+
+    # noniid truncated at 53: compare iid's value at <=53 (0.8 from
+    # round 50), NOT its round-99 final
+    cmp_tr = build_comparison({
+        "iid": run([50, 99], [0.8, 0.9]),
+        "noniid_lda0.5": run([25, 53], [0.7, 0.85]),
+    })
+    assert cmp_tr["truncated_arm"] == "noniid"
+    # mis-aligned cadences: each arm's ACTUAL compared round is recorded
+    assert cmp_tr["compared_at_round"] == {"iid": 50, "noniid": 53}
+    assert cmp_tr["horizons"] == {"iid": 99, "noniid": 53}
+    assert cmp_tr["final_acc_gap_iid_minus_noniid"] == \
+        round(0.8 - 0.85, 5)
+    # rounds_to_target censored to the common budget: an iid crossing
+    # at round 99 is NOT comparable against a 53-round arm
+    cmp_rtt = build_comparison({
+        "iid": run([50, 99], [0.8, 0.9], rtt=99),
+        "noniid_lda0.5": run([25, 53], [0.7, 0.85], rtt=25),
+    })
+    assert cmp_rtt["rounds_to_target_within_common_horizon"] == \
+        {"iid": None, "noniid": 25}
+    assert cmp_rtt["rounds_to_target"]["iid"] == 99  # raw kept
+    assert "caveat" in cmp_rtt["rounds_to_target"]
+
+    # the longer arm has NO eval inside the truncated horizon: no
+    # comparable operating point — incomplete, never a TypeError
+    cmp_none = build_comparison({
+        "iid": run([60, 99], [0.8, 0.9]),
+        "noniid_lda0.5": run([25, 53], [0.7, 0.85]),
+    })
+    assert cmp_none["incomplete"] is True
+    assert cmp_none["truncated_arm"] == "noniid"
+
+
+def test_parse_collective_bytes_reduce_scatter_scaling():
+    """ADVICE r5: a reduce-scatter's OUTPUT is V/N — the parser must
+    scale it by the replica-group size so the returned number is the
+    logical payload V (what the 2V(N-1)/N wire term charges), for both
+    replica_groups syntaxes; an unparsable group raises instead of
+    under-counting N x."""
+    import pytest
+
+    from scaling_model import parse_collective_bytes
+
+    explicit = ('  %rs = f32[4,8]{1,0} reduce-scatter(f32[32,8]{1,0} %x), '
+                'replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}\n')
+    parsed = parse_collective_bytes(explicit)
+    assert parsed["reduce-scatter"] == 4 * 8 * 4 * 8  # output bytes x N
+
+    iota = ('  %rs = bf16[2,8]{1,0} reduce-scatter(bf16[8,8]{1,0} %x), '
+            'replica_groups=[2,4]<=[8], dimensions={0}\n')
+    parsed = parse_collective_bytes(iota)
+    assert parsed["reduce-scatter"] == 2 * 8 * 2 * 4  # x group size 4
+
+    # async -start form: the tuple signature carries (operand, output);
+    # only the OUTPUT (last shape) scales — summing the tuple would
+    # over-count (N+1)x
+    start = ('  %rs = (f32[32,8]{1,0}, f32[4,8]{1,0}) '
+             'reduce-scatter-start(f32[32,8]{1,0} %x), '
+             'replica_groups={{0,1,2,3,4,5,6,7}}, dimensions={0}\n')
+    parsed = parse_collective_bytes(start)
+    assert parsed["reduce-scatter"] == 4 * 8 * 4 * 8  # output bytes x N
+
+    # all-gather-start's tuple is (operand_alias, output): only the
+    # gathered output is the payload
+    ag = ('  %ag = (f32[4,8]{1,0}, f32[32,8]{1,0}) '
+          'all-gather-start(f32[4,8]{1,0} %x), dimensions={0}\n')
+    assert parse_collective_bytes(ag)["all-gather"] == 32 * 8 * 4
+
+    with pytest.raises(ValueError, match="replica_groups"):
+        parse_collective_bytes(
+            "  %rs = f32[4]{0} reduce-scatter(f32[32]{0} %x)\n")

@@ -147,15 +147,36 @@ _SHAPE_RE = None  # compiled lazily (module imports stay cheap)
 _COLL_RE = None
 
 
+def _replica_group_size(line_tail: str):
+    """Per-group participant count from an HLO op's ``replica_groups``
+    attribute: explicit list form ``{{0,1,...},...}`` (size of the
+    first group) or iota form ``[n_groups,group_size]<=[total]``."""
+    import re
+
+    m = re.search(r"replica_groups=\{\{([0-9, ]*)\}", line_tail)
+    if m:
+        ids = [t for t in m.group(1).replace(" ", "").split(",") if t]
+        return len(ids) or None
+    m = re.search(r"replica_groups=\[\d+,(\d+)\]<=\[", line_tail)
+    if m:
+        return int(m.group(1))
+    return None
+
+
 def parse_collective_bytes(hlo_text: str) -> dict:
-    """Per-kind payload bytes of the cross-device collectives in an
-    optimized-HLO dump: for each ``all-reduce``/``all-gather``/
+    """Per-kind LOGICAL payload bytes V of the cross-device collectives
+    in an optimized-HLO dump: for each ``all-reduce``/``all-gather``/
     ``reduce-scatter``/``collective-permute``/``all-to-all`` op (and
     async ``-start`` form; ``-done`` consumes the started op and is
     skipped) sum the byte size of its OUTPUT shape(s).  For an
     all-reduce the output equals the payload V, so the ring wire
     traffic is 2·V·(N−1)/N per link — the exact term
-    ``model_efficiency`` charges."""
+    ``model_efficiency`` charges.  A reduce-scatter's OUTPUT is only
+    V/N, so its bytes are scaled up by the replica-group size parsed
+    from the op's ``replica_groups`` attribute (ADVICE r5: the raw
+    output sum would under-count its wire volume N×); an unparsable
+    group on a reduce-scatter raises rather than under-counting — the
+    no-unmodeled-collectives assertion in the tests stays the net."""
     import re
 
     global _SHAPE_RE, _COLL_RE
@@ -168,7 +189,7 @@ def parse_collective_bytes(hlo_text: str) -> dict:
     out: dict = {}
     for m in _COLL_RE.finditer(hlo_text):
         sig, kind = m.group(1), m.group(2)
-        total = 0
+        shapes = []
         for dt, dims in _SHAPE_RE.findall(sig):
             if dt not in _DTYPE_BYTES:
                 continue
@@ -176,7 +197,35 @@ def parse_collective_bytes(hlo_text: str) -> dict:
             for d in dims.split(","):
                 if d:
                     n *= int(d)
-            total += n * _DTYPE_BYTES[dt]
+            shapes.append(n * _DTYPE_BYTES[dt])
+        if kind == "reduce-scatter":
+            eol = hlo_text.find("\n", m.end())
+            tail = hlo_text[m.end(): eol if eol >= 0 else len(hlo_text)]
+            group = _replica_group_size(tail)
+            if group is None:
+                raise ValueError(
+                    "reduce-scatter without a parsable replica_groups "
+                    f"attribute: cannot scale its V/N output to the "
+                    f"payload V ({tail.strip()[:120]!r})"
+                )
+            # the async -start form's signature tuple carries the
+            # OPERAND alongside the V/N output — scale only the output
+            # (last shape); summing the whole tuple and scaling would
+            # over-count ~(N+1)x.  (A variadic async reduce-scatter
+            # would need operand/output splitting; none appears in any
+            # program the model charges — the no-unmodeled-collectives
+            # test is the net.)
+            total = shapes[-1] * group if shapes else 0
+        elif kind == "all-gather" and m.group(3):
+            # all-gather-START's tuple is (operand_alias, output): the
+            # gathered output alone is the logical payload V.  (Plain
+            # tuple-result all-gathers are the combiner pass's VARIADIC
+            # form — those sum, like all-reduce.)
+            total = shapes[-1] if shapes else 0
+        else:
+            # all-reduce tuples are VARIADIC OUTPUTS (one per reduced
+            # tensor, each of size V) — summing them is correct
+            total = sum(shapes)
         out[kind] = out.get(kind, 0) + total
         out["n_ops"] = out.get("n_ops", 0) + 1
     return out

@@ -149,11 +149,23 @@ def make_round_fn(
             client_vars,
         )
         den = weights.sum()
+        n_participants = participation.sum()
         if axis_name is not None:
             num = jax.lax.psum(num, axis_name)
             den = jax.lax.psum(den, axis_name)
+            n_participants = jax.lax.psum(n_participants, axis_name)
+        # zero-participation guard: with den == 0 (every client dropped
+        # or deadline-missed this round) the weighted average is
+        # undefined — 0/eps would ZERO the global model and the next
+        # round's gradients would NaN-poison it.  A participant-less
+        # round is a no-op update (the cross-device server's
+        # dropped_all semantics), and the driver counts it as degraded.
         agg = jax.tree_util.tree_map(
-            lambda s, ref: (s / jnp.maximum(den, 1e-12)).astype(ref.dtype),
+            lambda s, ref: jnp.where(
+                den > 0,
+                (s / jnp.maximum(den, 1e-12)).astype(ref.dtype),
+                ref,
+            ),
             num,
             state.variables,
         )
@@ -167,6 +179,9 @@ def make_round_fn(
             )
             for k, v in client_metrics.items()
         }
+        # realized cohort size: the drivers' degraded-round detector
+        # (participants == 0 -> rounds.degraded counter, model unchanged)
+        train_metrics["participants"] = n_participants
         new_state = ServerState(
             variables=new_vars,
             opt_state=new_opt,
@@ -471,6 +486,12 @@ class FedAvgSimulation:
             )
         )
         self.history = []
+        # checkpoint/resume wiring (attach_checkpointing): periodic full
+        # ServerState saves + fault-injection crash knob for resume tests
+        self._ckpt_mgr = None
+        self._ckpt_every = 0
+        self._ckpt_last: Optional[int] = None
+        self.crash_at_round: Optional[int] = None
         # (cohort key, device-resident packed block) — see _device_pack
         self._pack_cache: Optional[tuple] = None
         # logical model payload per participant per direction (fp32 wire
@@ -488,6 +509,61 @@ class FedAvgSimulation:
             server_update=self._server_update,
             aggregate_transform=self._aggregate_transform,
         )
+
+    # -- checkpoint/resume --------------------------------------------------
+    def attach_checkpointing(self, manager, every: int = 1) -> None:
+        """Wire periodic persistence: save the FULL round state pytree —
+        (variables, server opt state, round_idx, rng key) — every
+        ``every`` completed rounds and at the end of each run call.
+        Because every source of randomness derives from
+        ``fold_in(state.key, state.round_idx)``, a restored state
+        continues BIT-identically to the uninterrupted run
+        (``tests/test_checkpoint_metrics.py``)."""
+        self._ckpt_mgr = manager
+        self._ckpt_every = max(1, int(every))
+
+    def resume(self) -> int:
+        """Restore the latest readable checkpoint into ``self.state``;
+        returns the number of already-completed rounds (0 = fresh)."""
+        if self._ckpt_mgr is None or self._ckpt_mgr.latest_step() is None:
+            return 0
+        template = jax.tree_util.tree_map(np.asarray, self.state)
+        restored = self._ckpt_mgr.restore(like=template)
+        self.state = jax.tree_util.tree_map(jnp.asarray, restored)
+        done = int(self.state.round_idx)
+        self._ckpt_last = done
+        self.metrics.telemetry.event("resume", round=done)
+        return done
+
+    def _maybe_checkpoint(self, final: bool = False) -> None:
+        if self._ckpt_mgr is None:
+            return
+        step = int(self.state.round_idx)
+        if step == self._ckpt_last:  # this step is already on disk
+            return
+        if final or step % self._ckpt_every == 0:
+            with self.metrics.span("checkpoint"):
+                self._ckpt_mgr.save(step, self.state)
+            self._ckpt_last = step
+
+    def _crash_if_scheduled(self) -> None:
+        """Fault injection: hard-exit (as a SIGKILL would) right before
+        the scheduled round trains — the crash-then-``--resume``
+        bit-identity path of ``tools/chaos_run.py`` / ``experiments/run``."""
+        if (
+            self.crash_at_round is not None
+            and int(self.state.round_idx) == self.crash_at_round
+        ):
+            import os
+
+            os._exit(137)
+
+    def _count_degraded(self, row: dict) -> None:
+        """A round whose realized cohort was empty left the model
+        untouched (the round kernel's den>0 guard) — count it on the
+        same series the cross-device server uses."""
+        if row.get("participants", 1.0) <= 0:
+            self.metrics.telemetry.inc("rounds.degraded")
 
     def _extra_eval(self) -> dict:
         """Subclass hook: extra metrics at eval rounds (e.g. backdoor acc)."""
@@ -612,7 +688,9 @@ class FedAvgSimulation:
     def run(self, rounds: Optional[int] = None, log_fn=None) -> list:
         rounds = rounds if rounds is not None else self.cfg.comm_rounds
         for i in range(rounds):
+            self._crash_if_scheduled()
             metrics = self.run_round()
+            self._count_degraded(metrics)
             r = metrics["round"]
             # final-round eval keys on THIS call's last iteration, not the
             # absolute round index, so run(rounds=N) and resumed runs also
@@ -636,6 +714,8 @@ class FedAvgSimulation:
             self.history.append(metrics)
             if log_fn:
                 log_fn(metrics)
+            self._maybe_checkpoint()
+        self._maybe_checkpoint(final=True)
         return self.history
 
     def run_fused(
@@ -746,6 +826,7 @@ class FedAvgSimulation:
                         out["train_acc"] = out["correct"] / out["count"]
                         out["train_loss"] = out["loss_sum"] / out["count"]
                     self._annotate_round(out, chunk_ids[i], base + i)
+                    self._count_degraded(out)
                     rows.append(out)
             # fused drivers draw dropout ON DEVICE: the host can't see
             # the realized masks, so uploads use the expectation
@@ -770,6 +851,10 @@ class FedAvgSimulation:
                 if log_fn:
                     log_fn(r)
             done += n
+            # chunk boundaries are the fused drivers' checkpoint cadence
+            # (mid-chunk state never exists on the host)
+            self._maybe_checkpoint()
+        self._maybe_checkpoint(final=True)
         return self.history
 
     def run_fused_sampled(

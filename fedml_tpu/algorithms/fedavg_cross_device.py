@@ -17,6 +17,7 @@ where "messages" are XLA collectives.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Dict, Optional
 
@@ -60,6 +61,30 @@ class FedAvgServerManager(NodeManager):
     and the dropouts are logged.  Replies carry their round index, so a
     straggler's late upload from a closed round is discarded instead of
     corrupting the next aggregation.
+
+    Fault tolerance on top of that (the chaos-layer contract,
+    ``fedml_tpu/faults``):
+
+    - ``spares`` over-samples the cohort: ``clients_per_round + spares``
+      nodes get the sync and the round closes at the FIRST
+      ``clients_per_round`` uploads (or at the deadline with whoever
+      arrived).  The sample-weighted average renormalizes over the
+      realized reporters, so no weight correction is needed — spares
+      that report after the close are stale-rejected like any late
+      frame.
+    - uploads carrying non-finite parameters or weights (a corrupted
+      payload) are rejected BEFORE aggregation and counted
+      (``faults.observed{kind=corrupt_upload}``) — one flipped frame
+      must not NaN-poison the global model.
+    - degraded rounds (closed by deadline missing reporters, or with
+      nobody at all) increment the same ``rounds.degraded`` counter the
+      simulation drivers use — ONE series for both transports and both
+      execution modes (the unified deadline semantics).
+
+    These semantics are transport-independent: the deadline timer,
+    stale rejection, and degraded accounting behave identically over
+    the inproc bus and the TCP hub (pinned by ``tests/test_comm.py``
+    and ``tests/test_faults.py``).
     """
 
     def __init__(
@@ -73,6 +98,7 @@ class FedAvgServerManager(NodeManager):
         seed: int = 0,
         steps_per_epoch: Optional[int] = None,
         round_timeout: Optional[float] = None,
+        spares: int = 0,
     ):
         import threading
 
@@ -84,6 +110,13 @@ class FedAvgServerManager(NodeManager):
         self.variables = init_variables
         self.num_clients = num_clients
         self.clients_per_round = min(clients_per_round, num_clients)
+        # over-sampling: broadcast to K + spares nodes, aggregate the
+        # first K reporters (or whoever beat the deadline) — the
+        # FL-at-scale system design's straggler hedge
+        self.spares = max(0, int(spares))
+        self.broadcast_size = min(
+            self.clients_per_round + self.spares, num_clients
+        )
         self.comm_rounds = comm_rounds
         self.seed = seed
         self.round_idx = 0
@@ -91,6 +124,8 @@ class FedAvgServerManager(NodeManager):
         self.round_log = []
         self.round_timeout = round_timeout
         self.zero_participant_rounds = 0
+        self.rejected_uploads = 0  # non-finite (corrupt) models/weights
+        self._round_open_t = time.perf_counter()
         # _on_model runs on the backend reader thread, the deadline on a
         # Timer thread: one lock serializes round completion, and the
         # timer is generation-checked so a stale deadline (its round
@@ -107,8 +142,9 @@ class FedAvgServerManager(NodeManager):
     # -- protocol --
     def start(self):
         wire = tree_to_wire(self.variables)  # encode once, fan out N times
+        self._round_open_t = time.perf_counter()
         for node in self._sampled_nodes():
-            self.send_message(
+            self._send_or_log(
                 self._model_msg(MSG_TYPE_S2C_INIT_CONFIG, node, node - 1, wire)
             )
         self._arm_deadline()
@@ -126,26 +162,41 @@ class FedAvgServerManager(NodeManager):
         t.start()
 
     def _on_deadline(self, round_gen: int):
-        with self._round_lock:
-            if round_gen != self.round_idx or self.round_idx >= self.comm_rounds:
-                return  # stale timer: that round already closed
-            if not self.pending:
-                # nobody arrived: the global model is unchanged, the
-                # round still closes (an all-dropped round under the
-                # mask semantics is a no-op update)
-                self._close_round(dropped_all=True)
-                return
-            self._close_round()
+        try:
+            with self._round_lock:
+                if round_gen != self.round_idx or self.round_idx >= self.comm_rounds:
+                    return  # stale timer: that round already closed
+                if not self.pending:
+                    # nobody arrived: the global model is unchanged, the
+                    # round still closes (an all-dropped round under the
+                    # mask semantics is a no-op update)
+                    self._close_round(dropped_all=True)
+                    return
+                self._close_round()
+        except Exception:
+            # a Timer-thread exception dies silently; without the
+            # re-arm below the round would stay open forever (no later
+            # timer exists).  Re-arm UNCONDITIONALLY: if _close_round
+            # raised after round_idx += 1 (mid-broadcast), the timer it
+            # never reached must cover the NEW round — and a duplicate
+            # timer for an already-closed round is generation-checked
+            # into a no-op anyway.
+            logging.exception("round %d deadline close failed", round_gen)
+            with self._round_lock:
+                self._arm_deadline()
 
     def _sampled_nodes(self):
         """Seeded uniform sampling every round (the fork's hardcoded
-        formula, FedAvgServerManager.py:66-75, is deliberately absent)."""
-        if self.clients_per_round >= self.num_clients:
+        formula, FedAvgServerManager.py:66-75, is deliberately absent).
+        With ``spares`` the draw is ``clients_per_round + spares`` wide —
+        the extra nodes hedge stragglers; aggregation still targets the
+        first ``clients_per_round`` reporters."""
+        if self.broadcast_size >= self.num_clients:
             ids = np.arange(self.num_clients)
         else:
             rng = np.random.RandomState(self.seed * 100003 + self.round_idx)
             ids = np.sort(
-                rng.choice(self.num_clients, self.clients_per_round, replace=False)
+                rng.choice(self.num_clients, self.broadcast_size, replace=False)
             )
         return [int(i) + 1 for i in ids]  # node id = client id + 1
 
@@ -159,29 +210,85 @@ class FedAvgServerManager(NodeManager):
             m.add_params("steps_per_epoch", self.steps_per_epoch)
         return m
 
+    def _is_stale(self, msg: Message, reply_round) -> bool:
+        """Caller holds the round lock.  Discard a straggler's upload
+        from an already-closed round: aggregating it into the CURRENT
+        round would double-count its stale parameters (missing round
+        index = legacy client, accepted as current)."""
+        if reply_round is not None and reply_round != self.round_idx:
+            self.round_log.append(
+                {"round": self.round_idx, "stale_from": msg.sender,
+                 "stale_round": reply_round}
+            )
+            return True
+        return False
+
     def _on_model(self, msg: Message):
+        reply_round = msg.get(MSG_ARG_KEY_ROUND_INDEX)
         with self._round_lock:
-            # discard a straggler's upload from an already-closed round:
-            # aggregating it into the CURRENT round would double-count
-            # its stale parameters (missing round index = legacy client,
-            # accepted as current)
-            reply_round = msg.get(MSG_ARG_KEY_ROUND_INDEX)
-            if reply_round is not None and reply_round != self.round_idx:
-                self.round_log.append(
-                    {"round": self.round_idx, "stale_from": msg.sender,
-                     "stale_round": reply_round}
-                )
+            if self._is_stale(msg, reply_round):
+                return
+        # decode + validate OUTSIDE the round lock: both are O(model)
+        # (multi-MB b64 decode, full-tree finite scan) and K near-
+        # simultaneous uploads would otherwise serialize behind one
+        # lock with the deadline timer blocked at the back of the queue
+        try:
+            variables = tree_from_wire(
+                msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.variables
+            )
+        except Exception:
+            # an undecodable payload (truncated/garbled frame) is a
+            # fault observation, not a server crash
+            self._reject_upload(msg.sender, "undecodable_upload")
+            return
+        n = msg.get(MSG_ARG_KEY_NUM_SAMPLES)
+        # corrupt-payload firewall: one NaN leaf folded into the
+        # weighted sum would poison the global model for every
+        # round after — reject non-finite models/weights up front
+        if n is None or not np.isfinite(n) or n <= 0 or not all(
+            np.isfinite(np.asarray(l)).all()
+            for l in jax.tree_util.tree_leaves(variables)
+        ):
+            self._reject_upload(msg.sender, "corrupt_upload")
+            return
+        with self._round_lock:
+            # re-check: the round may have closed (deadline, or the
+            # K-th other reporter) while this upload was decoding
+            if self._is_stale(msg, reply_round):
                 return
             self.pending[msg.sender] = {
-                "variables": tree_from_wire(
-                    msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.variables
-                ),
-                "n": msg.get(MSG_ARG_KEY_NUM_SAMPLES),
+                "variables": variables,
+                "n": n,
                 "metrics": msg.get(MSG_ARG_KEY_LOCAL_METRICS) or {},
             }
             if len(self.pending) < self.clients_per_round:
                 return
-            self._close_round()
+            try:
+                self._close_round()
+            except Exception:
+                # same wedge prevention as _on_deadline: a close that
+                # raised mid-broadcast (round_idx already advanced, no
+                # deadline armed) must leave a timer behind, or nothing
+                # ever closes the new round
+                logging.exception("round close from upload path failed")
+                self._arm_deadline()
+
+    def _reject_upload(self, sender: int, kind: str) -> None:
+        """Log + count a bad upload (takes the round lock itself); the
+        round stays open — the deadline/other reporters close it."""
+        get_telemetry().inc("faults.observed", kind=kind,
+                            msg_type=MSG_TYPE_C2S_SEND_MODEL)
+        with self._round_lock:
+            self.rejected_uploads += 1
+            self.round_log.append(
+                {"round": self.round_idx, "rejected_from": sender,
+                 "kind": kind}
+            )
+            round_idx = self.round_idx
+        logging.warning(
+            "round %d: rejected %s from node %d (excluded from "
+            "aggregation)", round_idx, kind, sender,
+        )
 
     def _close_round(self, dropped_all: bool = False):
         """Aggregate whatever arrived and advance (caller holds the
@@ -191,11 +298,19 @@ class FedAvgServerManager(NodeManager):
             self._deadline_timer.cancel()
         sampled = set(self._sampled_nodes())
         time_agg = 0.0
+        entries = list(self.pending.values())
+        total = sum(e["n"] for e in entries)
+        if total <= 0:
+            # every reporter was rejected or weightless: same no-op
+            # semantics as nobody arriving (a 0-weight average is
+            # undefined — the compiled engine's den>0 guard, host-side)
+            dropped_all = True
         if not dropped_all:
-            # aggregate: sample-weighted average (FedAVGAggregator.py:58-87)
+            # aggregate: sample-weighted average (FedAVGAggregator.py:58-87);
+            # renormalization over the REALIZED reporters is the weight
+            # correction over-sampled/deadline-cut cohorts need — each
+            # weight is n_i / sum(n_arrived), never n_i / sum(n_sampled)
             t0 = time.perf_counter()
-            entries = list(self.pending.values())
-            total = sum(e["n"] for e in entries)
             self.variables = treelib.tree_weighted_sum(
                 [e["variables"] for e in entries],
                 [e["n"] / total for e in entries],
@@ -208,17 +323,38 @@ class FedAvgServerManager(NodeManager):
         # the per-round wall time a federation artifact reports
         rec = {"round": self.round_idx, "participants": sorted(self.pending),
                "time_agg": round(time_agg, 6), "t": round(time.time(), 3)}
-        dropped = sorted(sampled - set(self.pending))
-        if dropped:
-            rec["dropped"] = dropped  # deadline expired without them
+        missing = sorted(sampled - set(self.pending))
+        if len(self.pending) >= self.clients_per_round:
+            # the round closed at its K-report target: unreported nodes
+            # are over-sampled spares whose hedge wasn't needed — NOT
+            # dropouts (logging them as 'dropped' would make a healthy
+            # spared run indistinguishable from a mild drop fault)
+            dropped = []
+            if missing:
+                rec["spared"] = missing
+        else:
+            dropped = missing
+            if dropped:
+                rec["dropped"] = dropped  # deadline expired without them
+        tel = get_telemetry()
+        # server-side round wall time (open -> close): the recovery-span
+        # series a chaos soak reads next to span.reconnect_s
+        tel.observe("span.server_round_s",
+                    max(0.0, time.perf_counter() - self._round_open_t))
+        if len(self.pending) < self.clients_per_round:
+            # degraded: fewer reporters than the aggregation target
+            # (deadline cut, crashes, dropped frames) — same counter
+            # series the simulation drivers increment, so one number
+            # covers both transports (unified deadline semantics)
+            tel.inc("rounds.degraded")
+            tel.event("degraded_round", round=self.round_idx,
+                      arrived=len(self.pending), dropped=dropped)
         if not self.pending:
             # a zero-participant round is a silent no-op update; a run
             # where EVERY round is one (deadline shorter than client
             # train time — all uploads arrive a round late and are
             # stale-rejected) would otherwise "finish" with the init
             # model and rc=0.  Count them so callers can fail loudly.
-            import logging
-
             self.zero_participant_rounds += 1
             logging.warning(
                 "round %d closed with ZERO participants (deadline %.1fs; "
@@ -230,15 +366,38 @@ class FedAvgServerManager(NodeManager):
         self.round_idx += 1
         if self.round_idx >= self.comm_rounds:
             for node in range(1, self.num_clients + 1):
-                self.send_message(Message(MSG_TYPE_S2C_FINISH, SERVER, node))
+                self._send_or_log(Message(MSG_TYPE_S2C_FINISH, SERVER, node))
             self.finish()
             return
         wire = tree_to_wire(self.variables)
+        self._round_open_t = time.perf_counter()
         for node in self._sampled_nodes():
-            self.send_message(
+            self._send_or_log(
                 self._model_msg(MSG_TYPE_S2C_SYNC_MODEL, node, node - 1, wire)
             )
         self._arm_deadline()
+
+    def _send_or_log(self, msg: Message) -> None:
+        """Broadcast sends must not abort the round loop: a sync the
+        transport cannot deliver right now (hub restarting, socket
+        mid-reconnect — AFTER the backend's own bounded retries) just
+        makes that node a straggler this round; the deadline covers it.
+
+        Swallowing is only safe when a deadline EXISTS to cover the
+        lost frame (or the federation is already over — FINISH): with
+        ``round_timeout=None`` a dropped sync would hang the round
+        forever, so there the legacy fail-fast raise is preserved."""
+        try:
+            self.send_message(msg)
+        except OSError:
+            if self.round_timeout is None and msg.type != MSG_TYPE_S2C_FINISH:
+                raise
+            get_telemetry().inc("comm.send_failed", msg_type=msg.type)
+            logging.warning(
+                "round %d: could not deliver %s to node %d (will rely "
+                "on the round deadline)", self.round_idx, msg.type,
+                msg.receiver,
+            )
 
 
 class FedAvgClientManager(NodeManager):
@@ -254,6 +413,7 @@ class FedAvgClientManager(NodeManager):
         template_variables,
         seed: int = 0,
         train_delay: float = 0.0,
+        crash_at_round: Optional[int] = None,
     ):
         self.local_update = jax.jit(local_update.fn)
         self.dataset = dataset
@@ -264,6 +424,10 @@ class FedAvgClientManager(NodeManager):
         # artificial pre-training sleep: straggler injection for the
         # server's round-deadline path (tests/test_distributed_process)
         self.train_delay = train_delay
+        # deterministic process crash: hard-exit (no cleanup, no FINISH)
+        # when the sync for THIS round arrives — the chaos layer's
+        # SIGKILL-at-round-r, reproducible across runs
+        self.crash_at_round = crash_at_round
         super().__init__(backend)
 
     def register_message_receive_handlers(self):
@@ -272,6 +436,15 @@ class FedAvgClientManager(NodeManager):
         self.register_message_receive_handler(MSG_TYPE_S2C_FINISH, self._on_finish)
 
     def _on_sync(self, msg: Message):
+        if (
+            self.crash_at_round is not None
+            and msg.get(MSG_ARG_KEY_ROUND_INDEX) == self.crash_at_round
+        ):
+            import os
+
+            # os._exit: skip atexit/finally — the process dies exactly
+            # like a SIGKILL'd one, mid-protocol, socket left dangling
+            os._exit(137)
         if self.train_delay:
             import time
 

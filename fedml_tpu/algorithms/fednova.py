@@ -119,7 +119,11 @@ def make_fednova_round_fn(
             step_dir,
         )
         new_vars = {**state.variables, "params": new_params}
-        # non-param collections (e.g. batch_stats): plain weighted average
+        # non-param collections (e.g. batch_stats): plain weighted
+        # average.  Same zero-participation guard as make_round_fn: with
+        # total == 0 the p-weighted sum is all zeros and would ZERO the
+        # running statistics — keep the old collection instead (params
+        # are already safe: the nova step direction is 0).
         for coll in state.variables:
             if coll == "params":
                 continue
@@ -130,7 +134,10 @@ def make_fednova_round_fn(
             if axis_name is not None:
                 summed = jax.lax.psum(summed, axis_name)
             new_vars[coll] = jax.tree_util.tree_map(
-                lambda s, ref: s.astype(ref.dtype), summed, state.variables[coll]
+                lambda s, ref: jnp.where(
+                    total > 0, s.astype(ref.dtype), ref
+                ),
+                summed, state.variables[coll]
             )
 
         train_metrics = {
@@ -141,6 +148,10 @@ def make_fednova_round_fn(
             )
             for k, v in client_metrics.items()
         }
+        n_participants = participation.sum()
+        if axis_name is not None:
+            n_participants = jax.lax.psum(n_participants, axis_name)
+        train_metrics["participants"] = n_participants
         new_state = ServerState(
             variables=new_vars,
             opt_state=new_opt_state,

@@ -26,6 +26,7 @@ shutdown message, and device-side sync is XLA's.
 from __future__ import annotations
 
 import abc
+import logging
 import time
 from typing import Callable, Dict, List, Optional
 
@@ -107,9 +108,18 @@ class NodeManager(Observer):
     def receive_message(self, msg_type: str, msg: Message) -> None:
         handler = self._handlers.get(msg_type)
         if handler is None:
-            raise KeyError(
-                f"node {self.backend.node_id}: no handler for {msg_type!r}"
+            # A stray or late frame (a post-deadline model upload, a
+            # duplicate from a chaos run, a half-upgraded peer) is an
+            # EXPECTED event in a fault-tolerant federation — raising
+            # here used to kill the node's reader thread and silently
+            # wedge the whole run.  Log + count instead; chaos runs
+            # assert the counter against their injection schedule.
+            comm_obs.record_unhandled(msg_type)
+            logging.warning(
+                "node %d: no handler for %r from node %s — dropped",
+                self.backend.node_id, msg_type, msg.sender,
             )
+            return
         t0 = time.perf_counter()
         try:
             handler(msg)

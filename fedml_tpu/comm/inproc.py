@@ -30,6 +30,12 @@ class InprocBus:
         # one global FIFO of (receiver, msg): delivery follows true
         # cross-node send order, exactly as the drain docstring promises
         self._fifo: deque = deque()
+        # quiesce hooks: called when the FIFO runs dry, may enqueue more
+        # (return True if they did).  This is how the chaos layer models
+        # LATE delivery deterministically: a held message re-enters the
+        # bus only after everything in-flight drained — the synchronous
+        # twin of a post-deadline straggler frame.
+        self._quiesce_hooks = []
 
     def register(self, node_id: int) -> "InprocBackend":
         self._registered[node_id] = True
@@ -41,6 +47,11 @@ class InprocBus:
             raise KeyError(f"unknown receiver {msg.receiver}")
         self._fifo.append(msg)
 
+    def add_quiesce_hook(self, fn) -> None:
+        """Register ``fn() -> bool`` to run at drain quiescence; a True
+        return means it enqueued messages and the drain continues."""
+        self._quiesce_hooks.append(fn)
+
     def drain(self, max_steps: int = 100000) -> int:
         """Deliver queued messages in global send order until quiescent;
         handlers may enqueue more.  Messages to stopped nodes are
@@ -48,6 +59,11 @@ class InprocBus:
         delivered = 0
         for _ in range(max_steps):
             if not self._fifo:
+                # list-comp first: EVERY hook runs even if an earlier
+                # one released something (any() alone would short-circuit
+                # and starve later backends' held messages)
+                if any([h() for h in self._quiesce_hooks]):
+                    continue
                 return delivered
             msg = self._fifo.popleft()
             if self.stopped.get(msg.receiver, True):

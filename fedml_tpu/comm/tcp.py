@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import json
 import logging
+import random
 import socket
 import threading
 import time
@@ -27,6 +28,7 @@ from typing import Dict
 
 from fedml_tpu.comm.backend import CommBackend
 from fedml_tpu.comm.message import Message
+from fedml_tpu.obs.telemetry import get_telemetry
 
 _SENTINEL = {"__hub__": "stop"}
 _ACK = {"__hub__": "ack"}
@@ -38,6 +40,11 @@ class TcpHub:
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()
+        # frames to unregistered/dead receivers are dropped BY DESIGN
+        # (the deadline server treats the receiver as a straggler), but
+        # invisibly so until now: count them per message type so chaos
+        # runs can reconcile observed drops against injected ones
+        self.dropped_frames: Dict[str, int] = {}
         self._conns: Dict[int, socket.socket] = {}
         # per-connection send locks: sendall on a multi-MB frame loops
         # over partial sends, so two reader threads forwarding to the
@@ -102,7 +109,8 @@ class TcpHub:
                     break
                 receiver = frame.get("receiver")
                 if receiver is not None:
-                    self._forward(receiver, line)
+                    self._forward(receiver, line,
+                                  msg_type=frame.get("msg_type"))
         except OSError:
             pass  # peer vanished: fall through to cleanup
         finally:
@@ -118,11 +126,12 @@ class TcpHub:
             except OSError:
                 pass
 
-    def _forward(self, receiver: int, raw_line: bytes):
+    def _forward(self, receiver: int, raw_line: bytes, msg_type=None):
         with self._lock:
             conn = self._conns.get(receiver)
             send_lock = self._send_locks.get(receiver)
         if conn is None or send_lock is None:
+            self._count_drop(receiver, msg_type)
             return
         try:
             with send_lock:
@@ -132,10 +141,25 @@ class TcpHub:
         except OSError:
             # dead receiver: unregister so later sends don't retry it;
             # its own reader thread finishes cleanup
+            self._count_drop(receiver, msg_type)
             with self._lock:
                 if self._conns.get(receiver) is conn:
                     self._conns.pop(receiver, None)
                     self._send_locks.pop(receiver, None)
+
+    def _count_drop(self, receiver: int, msg_type) -> None:
+        mt = msg_type or "__hub__"
+        with self._lock:
+            self.dropped_frames[mt] = self.dropped_frames.get(mt, 0) + 1
+        get_telemetry().inc("hub.dropped_frames", msg_type=mt)
+        logging.debug("hub: dropped %s frame to unreachable node %s",
+                      mt, receiver)
+
+    def stats(self) -> dict:
+        """Hub-side fault accounting (``run_hub`` prints this at
+        shutdown so multi-process chaos drivers can collect it)."""
+        with self._lock:
+            return {"dropped_frames": dict(self.dropped_frames)}
 
     def stop(self):
         self._running = False
@@ -162,10 +186,16 @@ class TcpBackend(CommBackend):
     """
 
     def __init__(self, node_id: int, host: str, port: int,
-                 timeout: float = 30.0, auto_reconnect: int = 0):
+                 timeout: float = 30.0, auto_reconnect: int = 0,
+                 send_retries: int = 3):
         super().__init__(node_id)
         self._host, self._port, self._timeout = host, port, timeout
         self.auto_reconnect = auto_reconnect
+        # bounded retry budget for send_message: a transient OSError
+        # (hub restarting, conn mid-swap by the reconnect path) used to
+        # be terminal for the SENDER even though the reader thread was
+        # about to re-dial.  0 = fail fast (the pre-fault behavior).
+        self.send_retries = max(0, int(send_retries))
         self._stopped = threading.Event()
         # serializes send_message against _dial's socket swap: without
         # it, a send between "socket connected" and "hello written"
@@ -217,10 +247,38 @@ class TcpBackend(CommBackend):
         # JSON strings) — no re-parse needed
         t0 = time.perf_counter()
         data = (msg.to_json() + "\n").encode()
-        with self._send_lock:
-            self._sock.sendall(data)
+        # Bounded retry with exponential backoff + jitter: each attempt
+        # re-reads self._sock, so a reconnect (reader thread's _dial
+        # swapping the socket) between attempts is picked up.  A retry
+        # after a PARTIAL sendall can hand the hub a garbled first line
+        # — the hub drops malformed frames, so the worst case is one
+        # lost frame (the round deadline's job), never stream corruption.
+        # A backend killed by _kill_connection must not retry: the
+        # stream is desync-fatal by contract and callers expect OSError.
+        delay = 0.05
+        for attempt in range(self.send_retries + 1):
+            try:
+                with self._send_lock:
+                    self._sock.sendall(data)
+                break
+            except OSError:
+                if self._stopped.is_set() or attempt >= self.send_retries:
+                    raise
+                get_telemetry().inc("comm.send_retries", msg_type=msg.type)
+                time.sleep(delay * (1.0 + random.random()))
+                delay = min(delay * 2.0, 2.0)
         # exact wire bytes; latency covers serialize + socket write
+        # (including any backoff — a retried send IS that slow)
         self._record_send(msg, len(data), time.perf_counter() - t0)
+
+    def drop_connection(self) -> None:
+        """Fault injection: sever the hub connection WITHOUT stopping
+        the backend — ``run()`` sees EOF and, with ``auto_reconnect``,
+        re-dials and re-registers exactly as for a real network drop."""
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
 
     def await_peers(self, ids, timeout: float = 60.0) -> None:
         """Block until every node id in ``ids`` is registered at the hub.
@@ -307,6 +365,7 @@ class TcpBackend(CommBackend):
 
     def run(self) -> None:
         retries = self.auto_reconnect
+        lost_at = None  # perf_counter stamp of the FIRST EOF of an outage
         while not self._stopped.is_set():
             try:
                 line = self._file.readline()
@@ -318,9 +377,19 @@ class TcpBackend(CommBackend):
                 retries -= 1
                 import time as _time
 
+                if lost_at is None:
+                    lost_at = _time.perf_counter()
                 _time.sleep(0.2)
                 try:
                     self._dial()  # re-register; hub swaps the live conn
+                    # recovery span: total time this node was off the hub
+                    # (first EOF -> re-registered), the number a chaos
+                    # soak reads to bound reconnect impact
+                    t = get_telemetry()
+                    t.inc("comm.reconnects")
+                    t.observe("span.reconnect_s",
+                              _time.perf_counter() - lost_at)
+                    lost_at = None
                     logging.warning(
                         "node %d: hub connection lost — reconnected "
                         "(%d retries left)", self.node_id, retries,

@@ -19,6 +19,12 @@ import numpy as np
 PyTree = Any
 
 
+class UnreadableCheckpoint(Exception):
+    """An on-disk checkpoint artifact that cannot be decoded (truncated
+    by a crash, garbage bytes, half-synced step dir) — distinct from a
+    TEMPLATE mismatch, which is a caller config error and always raises."""
+
+
 def _flatten_for_npz(tree: PyTree) -> dict:
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     out = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)}
@@ -66,6 +72,12 @@ class CheckpointManager:
         os.makedirs(self.directory, exist_ok=True)
         self.max_to_keep = max_to_keep
         self._mgr = None
+        if os.environ.get("FEDML_TPU_NPZ_CKPT") == "1":
+            # forced npz fallback: lets tests (and orbax-less deploys)
+            # exercise the atomic-write/skip-corrupt path on a box where
+            # orbax happens to be installed
+            self._ocp = None
+            return
         try:
             import orbax.checkpoint as ocp
 
@@ -88,10 +100,20 @@ class CheckpointManager:
             )
             self._mgr.wait_until_finished()
             return
-        np.savez(
-            os.path.join(self.directory, f"ckpt_{step}.npz"),
-            **_flatten_for_npz(state),
-        )
+        # write-then-rename: np.savez straight to the final path would
+        # leave a TRUNCATED ckpt_<latest>.npz if the process dies
+        # mid-save — corrupting exactly the checkpoint resume wants.
+        # os.replace is atomic on POSIX, so the final name only ever
+        # holds a complete archive.
+        final = os.path.join(self.directory, f"ckpt_{step}.npz")
+        tmp = final + ".tmp"
+        try:
+            with open(tmp, "wb") as fh:
+                np.savez(fh, **_flatten_for_npz(state))
+            os.replace(tmp, final)
+        finally:
+            if os.path.exists(tmp):
+                os.remove(tmp)
         self._gc_npz()
 
     def latest_step(self) -> Optional[int]:
@@ -101,28 +123,83 @@ class CheckpointManager:
         return max(steps) if steps else None
 
     def restore(self, like: PyTree, step: Optional[int] = None) -> PyTree:
-        """Restore ``step`` (default: latest) with ``like`` as the
-        structure/dtype template."""
-        step = step if step is not None else self.latest_step()
-        if step is None:
+        """Restore ``step`` (default: latest READABLE) with ``like`` as
+        the structure/dtype template.
+
+        With no explicit ``step``, unreadable checkpoints (truncated by
+        a crash, half-synced, garbage bytes) are SKIPPED with a warning
+        and the next-newest step is tried — a fault-tolerant run must
+        not die on the artifact a previous crash left behind.  Template
+        mismatches (wrong treedef / leaf shapes: a checkpoint from a
+        DIFFERENT model) still raise — that is a config error, not
+        corruption.  An explicit ``step`` raises on any failure."""
+        if step is not None:
+            return self._restore_step(step, like)
+        steps = self._all_steps()
+        if not steps:
             raise FileNotFoundError(f"no checkpoints in {self.directory}")
+        last_err: Optional[Exception] = None
+        for s in sorted(steps, reverse=True):
+            try:
+                return self._restore_step(s, like)
+            except UnreadableCheckpoint as e:  # corrupt artifact: try older
+                import logging
+
+                last_err = e
+                logging.warning(
+                    "checkpoint step %d in %s is unreadable (%s) — "
+                    "trying the previous one", s, self.directory,
+                    e.__cause__ or e,
+                )
+        raise FileNotFoundError(
+            f"no READABLE checkpoint in {self.directory} "
+            f"(steps tried: {sorted(steps, reverse=True)})"
+        ) from last_err
+
+    def _all_steps(self):
+        if self._mgr is not None:
+            return list(self._mgr.all_steps())
+        return self._npz_steps()
+
+    def _restore_step(self, step: int, like: PyTree) -> PyTree:
         template = jax.tree_util.tree_map(np.asarray, like)
         if self._mgr is not None:
-            restored = self._mgr.restore(
-                step, args=self._ocp.args.StandardRestore(template)
-            )
+            try:
+                restored = self._mgr.restore(
+                    step, args=self._ocp.args.StandardRestore(template)
+                )
+            except ValueError:
+                raise  # orbax structure mismatch: config error
+            except Exception as e:  # half-written step dir etc.
+                raise UnreadableCheckpoint(
+                    f"orbax step {step} unreadable"
+                ) from e
         else:
-            z = np.load(os.path.join(self.directory, f"ckpt_{step}.npz"))
             leaves, treedef = jax.tree_util.tree_flatten(template)
-            saved_def = bytes(z["__treedef__"]).decode()
-            if saved_def != repr(treedef):
+            # decode failures classify as "unreadable" (skipped by the
+            # latest-readable scan); only a CLEANLY-read treedef that
+            # disagrees is a template/config error.  The treedef is
+            # compared BEFORE indexing template-counted leaf keys —
+            # otherwise a complete archive from a SMALLER model would
+            # KeyError on leaf_<i> and masquerade as corruption.
+            path = os.path.join(self.directory, f"ckpt_{step}.npz")
+            try:
+                with np.load(path) as z:
+                    saved_def = bytes(z["__treedef__"]).decode()
+                    raw = None
+                    if saved_def == repr(treedef):
+                        raw = [np.array(z[f"leaf_{i}"])
+                               for i in range(len(leaves))]
+            except Exception as e:
+                raise UnreadableCheckpoint(
+                    f"npz step {step} unreadable"
+                ) from e
+            if raw is None:
                 raise ValueError(
                     "checkpoint tree structure does not match the restore "
                     f"template:\n saved: {saved_def}\n template: {treedef!r}"
                 )
-            restored = jax.tree_util.tree_unflatten(
-                treedef, [z[f"leaf_{i}"] for i in range(len(leaves))]
-            )
+            restored = jax.tree_util.tree_unflatten(treedef, raw)
         _check_leaf_shapes(template, restored)
         # match the template's leaf dtypes/types (jnp arrays where needed)
         return jax.tree_util.tree_map(
@@ -132,11 +209,18 @@ class CheckpointManager:
 
     # ---- npz fallback helpers ----------------------------------------
     def _npz_steps(self):
-        return [
-            int(f[len("ckpt_"):-len(".npz")])
-            for f in os.listdir(self.directory)
-            if f.startswith("ckpt_") and f.endswith(".npz")
-        ]
+        # strict ckpt_<int>.npz match: a stray ckpt_old.npz or backup
+        # copy in the directory must not crash latest_step()/restore()
+        # (the skip-unreadable machinery is pointless if step LISTING
+        # dies on garbage first)
+        import re
+
+        steps = []
+        for f in os.listdir(self.directory):
+            m = re.fullmatch(r"ckpt_(\d+)\.npz", f)
+            if m:
+                steps.append(int(m.group(1)))
+        return steps
 
     def _gc_npz(self):
         steps = sorted(self._npz_steps())

@@ -77,6 +77,49 @@ def _connect_backend(node_id: int, host: str, port: int, retries: int = 50,
             time.sleep(0.1)
 
 
+def _chaos_plan():
+    from fedml_tpu.faults import FaultPlan
+
+    return FaultPlan.from_env()
+
+
+def _maybe_chaos(backend, role: str, plan=None):
+    """Wrap the transport in a ``ChaosBackend`` when a fault plan rides
+    the ``FEDML_TPU_CHAOS`` env var and names this role — how
+    ``tools/chaos_run.py`` injects message faults into worker
+    subprocesses without new plumbing on every entry point."""
+    from fedml_tpu.faults import ChaosBackend
+
+    plan = plan if plan is not None else _chaos_plan()
+    if plan is None or role not in plan.roles:
+        return backend
+    return ChaosBackend(backend, plan)
+
+
+def _collect_json_lines(stream, info: dict) -> None:
+    """Fold every parseable JSON line of a finished process's stdout
+    into ``info`` (server fault counters, hub stats)."""
+    if stream is None:
+        return
+    for line in stream.read().splitlines():
+        try:
+            info.update(json.loads(line))
+        except json.JSONDecodeError:
+            continue
+
+
+def _resolve_crash_round(flag_value: int, plan, node_id: int):
+    """Crash schedule precedence: an explicit ``--crash-at-round`` flag
+    wins; otherwise the env-shipped plan's ``crash_at_round`` map is
+    consulted for this node (the FaultPlan knob is live, not just
+    serialized)."""
+    if flag_value >= 0:
+        return flag_value
+    if plan is not None:
+        return plan.crash_at_round.get(node_id)
+    return None
+
+
 def run_hub(host: str, port: int) -> None:
     from fedml_tpu.comm.tcp import TcpHub
 
@@ -95,6 +138,9 @@ def run_hub(host: str, port: int) -> None:
             time.sleep(0.1)
     finally:
         hub.stop()
+        # hub-side fault accounting for the launcher (dropped frames by
+        # message type — chaos runs reconcile these against injections)
+        print(json.dumps({"hub_stats": hub.stats()}), flush=True)
 
 
 def run_server(args) -> None:
@@ -106,7 +152,11 @@ def run_server(args) -> None:
     from fedml_tpu.algorithms.fedavg_cross_device import FedAvgServerManager
 
     ds, bundle, init, lu = _build_problem(args.seed, args.num_clients)
-    backend = _connect_backend(0, args.host, args.port)
+    backend = _maybe_chaos(
+        _connect_backend(0, args.host, args.port,
+                         auto_reconnect=max(args.auto_reconnect, 0)),
+        "server",
+    )
     # cohort-wide pack geometry (fedavg_cross_device.py:62-66): each
     # client's single-client pack must match its slice of the
     # simulation's cohort pack even with heterogeneous client sizes
@@ -130,6 +180,7 @@ def run_server(args) -> None:
         comm_rounds=args.rounds, seed=args.seed,
         steps_per_epoch=steps,
         round_timeout=args.round_timeout or None,
+        spares=args.spares,
     )
     # startup barrier: the hub drops frames to unregistered receivers,
     # so broadcasting before every client registered would hang
@@ -148,9 +199,21 @@ def run_server(args) -> None:
             rounds=server.round_idx,
             round_log=json.dumps(server.round_log),
         )
+    # fault accounting alongside the round count: the process-local
+    # telemetry registry dies with this process, so surface the chaos
+    # counters on stdout where the launcher/chaos driver collects them
+    from fedml_tpu.obs.telemetry import get_telemetry
+
+    snap = get_telemetry().snapshot()["counters"]
     print(json.dumps({
         "rounds": server.round_idx,
         "zero_participant_rounds": server.zero_participant_rounds,
+        "rejected_uploads": server.rejected_uploads,
+        "rounds_degraded": snap.get("rounds.degraded", 0),
+        "faults": {k: v for k, v in snap.items()
+                   if k.startswith(("faults.", "comm.unhandled",
+                                    "comm.send_retries", "comm.send_failed",
+                                    "comm.reconnects"))},
     }), flush=True)
     if server.zero_participant_rounds >= server.comm_rounds:
         # every round aggregated nobody (deadline shorter than client
@@ -170,12 +233,22 @@ def run_client(args) -> None:
     # clients ride out transient hub-connection drops: re-dial +
     # re-register, rejoining as a straggler for the missed round (the
     # server's round deadline covers the gap)
-    backend = _connect_backend(args.node_id, args.host, args.port,
-                               auto_reconnect=3)
+    plan = _chaos_plan()
+    # -1 = role default (3): `or 3` would silently promote an EXPLICIT
+    # --auto-reconnect 0 (fail-fast) back to reconnecting
+    reconnect = args.auto_reconnect if args.auto_reconnect >= 0 else 3
+    backend = _maybe_chaos(
+        _connect_backend(args.node_id, args.host, args.port,
+                         auto_reconnect=reconnect),
+        "client", plan,
+    )
     FedAvgClientManager(
         backend, lu, ds, batch_size=args.batch_size,
         template_variables=init, seed=args.seed,
         train_delay=args.train_delay,
+        crash_at_round=_resolve_crash_round(
+            args.crash_at_round, plan, args.node_id
+        ),
     )
     backend.run()  # returns on FINISH
 
@@ -192,6 +265,13 @@ def launch(
     round_timeout: float = 0.0,
     slow_client_delay: float = 0.0,
     kill_slow_client_after: float = 0.0,
+    crash_client_at_round: int = -1,
+    restart_hub_after: float = 0.0,
+    clients_per_round: int = 0,
+    spares: int = 0,
+    auto_reconnect: int = 0,
+    chaos_plan: str = "",
+    info=None,
     env=None,
     server_env=None,
     timeout: float = 180.0,
@@ -209,10 +289,32 @@ def launch(
     ``num_clients``) sleep that long before each local update;
     ``kill_slow_client_after`` SIGKILLs it mid-sleep — i.e. a SAMPLED
     client dies mid-round.  With ``round_timeout`` set the server's
-    deadline aggregates without it and logs the dropout."""
+    deadline aggregates without it and logs the dropout.
+
+    Chaos knobs (``tools/chaos_run.py`` and the marked-slow scenarios in
+    ``tests/test_distributed_process.py``):
+
+    - ``crash_client_at_round``: the LAST sampled client hard-exits
+      (``os._exit``) when that round's sync arrives — deterministic
+      SIGKILL-at-round-r;
+    - ``restart_hub_after``: SIGKILL the hub that long after the whole
+      federation registered, then restart it on the SAME port — workers
+      must auto-reconnect (pass ``auto_reconnect``) and the deadline
+      must absorb the frames lost in the outage;
+    - ``chaos_plan``: ``FaultPlan`` JSON shipped to workers via the
+      ``FEDML_TPU_CHAOS`` env var (message-level drop/corrupt/...);
+    - ``info``: optional dict the launcher fills with the server's
+      final stdout JSON (fault counters) and the hub's shutdown stats.
+    """
     env = dict(env or os.environ)
+    if chaos_plan:
+        env["FEDML_TPU_CHAOS"] = chaos_plan
+        if server_env is not None:
+            server_env = dict(server_env)
+            server_env["FEDML_TPU_CHAOS"] = chaos_plan
     me = [sys.executable, "-m", "fedml_tpu.experiments.distributed_fedavg"]
     hub = None
+    hubs = []
     procs = []
     killed_registered_peer = False
     try:
@@ -220,6 +322,7 @@ def launch(
             me + ["--role", "hub", "--port", "0"],
             stdout=subprocess.PIPE, text=True, env=env,
         )
+        hubs.append(hub)
         port_line = hub.stdout.readline()
         if not port_line:
             raise RuntimeError("hub died before announcing its port")
@@ -229,11 +332,23 @@ def launch(
                   "--seed", str(seed), "--batch-size", str(batch_size)]
         if round_timeout:
             common += ["--round-timeout", str(round_timeout)]
+        if clients_per_round:
+            # required for spares to bite: with the default (everyone
+            # sampled) broadcast_size = min(K+S, num_clients) collapses
+            # back to K and over-sampling is a no-op
+            common += ["--clients-per-round", str(clients_per_round)]
+        if spares:
+            common += ["--spares", str(spares)]
+        if auto_reconnect:
+            common += ["--auto-reconnect", str(auto_reconnect)]
         clients = [
             subprocess.Popen(
                 me + ["--role", "client", "--node-id", str(i + 1)] + common
                 + (["--train-delay", str(slow_client_delay)]
-                   if slow_client_delay and i == num_clients - 1 else []),
+                   if slow_client_delay and i == num_clients - 1 else [])
+                + (["--crash-at-round", str(crash_client_at_round)]
+                   if crash_client_at_round >= 0 and i == num_clients - 1
+                   else []),
                 env=env,
             )
             for i in range(num_clients)
@@ -255,8 +370,33 @@ def launch(
         server = subprocess.Popen(
             me + ["--role", "server", "--out", out_path] + common,
             env=dict(server_env) if server_env is not None else env,
+            stdout=subprocess.PIPE if info is not None else None,
+            text=True if info is not None else None,
         )
         procs.append(server)
+        if restart_hub_after:
+            # wait until the WHOLE federation registered (the startup
+            # barrier passed), let a round get going, then SIGKILL the
+            # hub and restart it on the same port: every worker must
+            # re-dial + re-register, and frames lost in the outage are
+            # absorbed by the round deadline
+            from fedml_tpu.comm.tcp import TcpBackend
+
+            mon = TcpBackend(9997, "127.0.0.1", port)
+            mon.await_peers([0] + list(range(1, num_clients + 1)),
+                            timeout=60 + 15 * num_clients)
+            mon.stop()
+            time.sleep(restart_hub_after)
+            hub.kill()  # SIGKILL: no sentinel, no graceful close
+            hub.wait(timeout=10)
+            time.sleep(0.5)  # a beat of real downtime
+            hub = subprocess.Popen(
+                me + ["--role", "hub", "--port", str(port)],
+                stdout=subprocess.PIPE, text=True, env=env,
+            )
+            hubs.append(hub)
+            if not hub.stdout.readline():
+                raise RuntimeError("restarted hub died before binding")
         if kill_slow_client_after and slow_client_delay:
             # wait until EVERYONE (clients + server) is registered — the
             # server's await_peers barrier has then passed, so killing
@@ -286,8 +426,16 @@ def launch(
             killed_registered_peer = True
             monitor.stop()
         rc = server.wait(timeout=timeout)
+        if info is not None:
+            _collect_json_lines(server.stdout, info)
         for c in clients:
-            c.wait(timeout=30)
+            try:
+                c.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                # a wedged client must not fail the launcher: under
+                # chaos a client whose FINISH was lost blocks forever —
+                # reap it (the server outcome is what the caller asserts)
+                c.kill()
         if extra_idle_clients:
             assert killed_registered_peer
         return rc
@@ -295,9 +443,14 @@ def launch(
         for p in procs:
             if p.poll() is None:
                 p.kill()
-        if hub is not None:
+        if hub is not None and hub.poll() is None:
             hub.terminate()
             hub.wait(timeout=10)
+            if info is not None:
+                _collect_json_lines(hub.stdout, info)
+        for h in hubs:
+            if h.poll() is None:
+                h.kill()
 
 
 def main(argv=None):
@@ -316,6 +469,13 @@ def main(argv=None):
     # the reference's behavior) and client-side artificial train delay
     p.add_argument("--round-timeout", type=float, default=0.0)
     p.add_argument("--train-delay", type=float, default=0.0)
+    # fault-tolerance knobs (chaos layer): over-sampled spare clients,
+    # reconnect budget (-1 = role default: 0 for the server — legacy
+    # fail-fast — and 3 for clients; an explicit 0 means 0 for both),
+    # deterministic client crash
+    p.add_argument("--spares", type=int, default=0)
+    p.add_argument("--auto-reconnect", type=int, default=-1)
+    p.add_argument("--crash-at-round", type=int, default=-1)
     args = p.parse_args(argv)
     if args.role == "hub":
         run_hub(args.host, args.port)

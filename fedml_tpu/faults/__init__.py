@@ -1,0 +1,33 @@
+"""Deterministic fault injection — the chaos layer (PAPER.md robustness
+story; reference FedML's only failure path is ``MPI.COMM_WORLD.Abort()``).
+
+- ``plan``  — seeded ``FaultPlan``/``FaultSpec``/``FaultRule`` schedules
+  (stdlib-only; shipped to subprocesses via ``FEDML_TPU_CHAOS``);
+- ``chaos`` — ``ChaosBackend``, the transport wrapper applying a plan on
+  send/notify paths of inproc and tcp.
+
+Process-level injection (SIGKILL at round r, hub restart) lives with the
+process orchestration: ``experiments/distributed_fedavg.py`` and
+``tools/chaos_run.py``.
+"""
+
+from fedml_tpu.faults.plan import (
+    ACTIONS,
+    DEFAULT_FAULTABLE,
+    ENV_VAR,
+    FaultPlan,
+    FaultRule,
+    FaultSpec,
+)
+from fedml_tpu.faults.chaos import ChaosBackend, corrupt_message
+
+__all__ = [
+    "ACTIONS",
+    "DEFAULT_FAULTABLE",
+    "ENV_VAR",
+    "ChaosBackend",
+    "FaultPlan",
+    "FaultRule",
+    "FaultSpec",
+    "corrupt_message",
+]

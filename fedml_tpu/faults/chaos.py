@@ -1,0 +1,269 @@
+"""``ChaosBackend`` — fault-injecting ``CommBackend`` wrapper.
+
+Wraps ANY transport (the deterministic inproc bus or the TCP hub
+backend) and applies a ``FaultPlan`` on both paths:
+
+- **send**: ``send_message`` consults the plan before handing the frame
+  to the inner transport — drop, corrupt (NaN-fill a model leaf),
+  duplicate, delay/reorder, or sever the connection after sending;
+- **notify (recv)**: the wrapper registers itself as the inner
+  backend's observer and re-delivers to ITS observers, applying the
+  plan's recv mix on the way — a delayed inbound upload is exactly the
+  post-deadline straggler frame the server must stale-reject.
+
+Delay semantics per transport:
+
+- inproc: hold the message for ``delay_msgs`` subsequent messages on the
+  same path, and flush any still-held messages when the bus quiesces
+  (``InprocBus.add_quiesce_hook``) — a "late arrival" in the
+  synchronous simulation, with a fully deterministic delivery trace;
+- tcp: a daemon ``threading.Timer`` re-injects after ``delay_s`` wall
+  seconds (real transports are allowed real nondeterminism; the
+  determinism contract is the inproc trace).
+
+Telemetry: every injected fault increments
+``faults.injected{action=...,msg_type=...}`` on the process registry, so
+chaos runs can assert ``observed == injected`` against the tolerance
+layer's ``faults.observed``/``hub.dropped_frames`` counters.  The
+wrapper does NOT double-count ``comm.*`` series: sends are recorded by
+the inner transport, receives by the inner ``_notify``.
+"""
+
+from __future__ import annotations
+
+import base64
+import logging
+import threading
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from fedml_tpu.comm.backend import CommBackend, Observer
+from fedml_tpu.comm.message import Message
+from fedml_tpu.faults.plan import FaultPlan
+from fedml_tpu.obs.telemetry import get_telemetry
+
+
+def corrupt_message(msg: Message, rng) -> Optional[Message]:
+    """Copy-on-write payload corruption: NaN-fill one float leaf of the
+    first wire pytree found in the params (the model payload).  Returns
+    the corrupted COPY, or None if nothing corruptible — shared param
+    dicts are never mutated in place (on inproc the same objects travel
+    to the receiver)."""
+    for key, value in msg.params.items():
+        if not (isinstance(value, dict) and "__wiretree__" in value):
+            continue
+        leaves = value.get("leaves") or []
+        float_idx = [
+            i for i, l in enumerate(leaves)
+            if isinstance(l, dict) and "__ndarray__" in l
+            and np.dtype(l.get("dtype", "float32")).kind == "f"
+        ]
+        if not float_idx:
+            continue
+        i = float_idx[rng.randrange(len(float_idx))]
+        leaf = dict(leaves[i])
+        bad = np.full(leaf.get("shape") or (),
+                      np.nan, dtype=np.dtype(leaf.get("dtype", "float32")))
+        leaf["__ndarray__"] = base64.b64encode(bad.tobytes()).decode()
+        new_leaves = list(leaves)
+        new_leaves[i] = leaf
+        twin = Message()
+        twin.params = dict(msg.params)
+        twin.params[key] = {**value, "leaves": new_leaves}
+        return twin
+    return None
+
+
+class _Bridge(Observer):
+    """Inner backend's observer: routes deliveries through the chaos
+    recv path (the wrapper itself stays a CommBackend, not an
+    Observer)."""
+
+    def __init__(self, chaos: "ChaosBackend"):
+        self.chaos = chaos
+
+    def receive_message(self, msg_type: str, msg: Message) -> None:
+        self.chaos._on_inner_message(msg)
+
+
+class ChaosBackend(CommBackend):
+    """Fault-injecting decorator around an inner ``CommBackend``.
+
+    Node managers attach to THIS backend; the inner transport keeps its
+    protocol behavior (registration, reconnect, telemetry) untouched.
+    ``trace`` records every chaos decision as
+    ``(direction, msg_type, seq, actions)`` tuples — the deterministic
+    delivery trace ``tests/test_faults.py`` pins across runs.
+    """
+
+    def __init__(self, inner: CommBackend, plan: FaultPlan,
+                 telemetry=None):
+        super().__init__(inner.node_id)
+        self.inner = inner
+        self.plan = plan
+        self.telemetry = telemetry or get_telemetry()
+        self.trace: List[tuple] = []
+        self._seq = {}  # (direction, msg_type) -> next sequence number
+        self._held = {"send": [], "recv": []}  # [remaining, msg] entries
+        self._lock = threading.Lock()
+        # wall-clock transports (tcp) delay via timers; the inproc bus
+        # delays via held-message ticks + a quiesce flush
+        bus = getattr(inner, "bus", None)
+        self._deterministic = bus is not None
+        if bus is not None and hasattr(bus, "add_quiesce_hook"):
+            bus.add_quiesce_hook(self.flush_held)
+        inner.add_observer(_Bridge(self))
+
+    # -- fault application --------------------------------------------------
+    def _next_seq(self, direction: str, msg_type: str) -> int:
+        with self._lock:
+            key = (direction, msg_type)
+            seq = self._seq.get(key, 0)
+            self._seq[key] = seq + 1
+            return seq
+
+    def _inject(self, action: str, msg_type: str) -> None:
+        self.telemetry.inc("faults.injected", action=action, msg_type=msg_type)
+
+    def _apply(self, direction: str, msg: Message,
+               forward: Callable[[Message], None]) -> None:
+        msg_type = msg.type
+        if not self.plan.applies_to(msg_type):
+            forward(msg)
+            self._tick(direction)
+            return
+        seq = self._next_seq(direction, msg_type)
+        acts = self.plan.decide(
+            self.node_id, direction, msg_type, seq, msg.get("round_idx")
+        )
+        self.trace.append(
+            (direction, msg_type, seq,
+             tuple(a["action"] for a in acts) or ("deliver",))
+        )
+        if any(a["action"] == "drop" for a in acts):
+            self._inject("drop", msg_type)
+            self._tick(direction)
+            return
+        disconnect = False
+        delay = None
+        new_hold = None
+        for a in acts:
+            kind = a["action"]
+            if kind == "corrupt":
+                twin = corrupt_message(
+                    msg, self.plan.rng_for(self.node_id, direction,
+                                           msg_type, seq, salt="corrupt")
+                )
+                if twin is not None:
+                    msg = twin
+                    self._inject("corrupt", msg_type)
+            elif kind == "duplicate":
+                self._inject("duplicate", msg_type)
+                forward(msg)
+            elif kind in ("delay", "reorder"):
+                delay = a
+            elif kind == "disconnect":
+                disconnect = True
+        if delay is not None:
+            self._inject(delay["action"], msg_type)
+            if self._deterministic:
+                new_hold = [max(1, int(delay.get("delay_msgs", 1))),
+                            msg, forward]
+                with self._lock:
+                    self._held[direction].append(new_hold)
+            else:
+                t = threading.Timer(
+                    float(delay.get("delay_s", 0.05)), forward, args=(msg,)
+                )
+                t.daemon = True
+                t.start()
+        else:
+            forward(msg)
+        # age PRIOR holds only: the entry added by THIS call must survive
+        # its own tick, or delay_msgs=1 (reorder) would release the
+        # message immediately in its original position — a silent no-op
+        self._tick(direction, skip=new_hold)
+        if disconnect:
+            dropper = getattr(self.inner, "drop_connection", None)
+            if dropper is not None:
+                self._inject("disconnect", msg_type)
+                dropper()
+
+    def _tick(self, direction: str, skip=None) -> None:
+        """One message moved on this path: age held messages (except
+        ``skip``, the hold this very call created), release the ones
+        whose delay expired.  Release runs AFTER the current message
+        forwarded, so a delay_msgs=1 hold is a true swap with the next
+        message — the reorder semantics."""
+        release = []
+        with self._lock:
+            remaining = []
+            for entry in self._held[direction]:
+                if entry is skip:
+                    remaining.append(entry)
+                    continue
+                entry[0] -= 1
+                (release if entry[0] <= 0 else remaining).append(entry)
+            self._held[direction] = remaining
+        for _, msg, forward in release:
+            forward(msg)
+
+    def flush_held(self) -> bool:
+        """Release every held message (the bus ran dry: a held upload
+        now arrives 'late', after whatever deadline logic already ran).
+        Returns True if anything was released — the quiesce-hook
+        contract."""
+        with self._lock:
+            held = self._held["send"] + self._held["recv"]
+            self._held = {"send": [], "recv": []}
+        for _, msg, forward in held:
+            forward(msg)
+        return bool(held)
+
+    # -- CommBackend surface ------------------------------------------------
+    def send_message(self, msg: Message) -> None:
+        self._apply("send", msg, self.inner.send_message)
+
+    def _deliver(self, msg: Message) -> None:
+        # inner._notify already recorded comm.recv for this frame —
+        # deliver straight to OUR observers without re-counting
+        for obs in list(self._observers):
+            obs.receive_message(msg.type, msg)
+
+    def _on_inner_message(self, msg: Message) -> None:
+        if self.plan.straggler_sleep_s > 0.0 and not self._deterministic:
+            import time
+
+            time.sleep(self.plan.straggler_sleep_s)
+        if self.plan.recv_spec is None and not any(
+            r.direction == "recv" for r in self.plan.rules
+        ):
+            self._deliver(msg)
+            return
+        try:
+            self._apply("recv", msg, self._deliver)
+        except Exception:
+            # a chaos bug must degrade to delivery, not kill the reader
+            logging.exception("chaos recv path failed; delivering as-is")
+            self._deliver(msg)
+
+    def run(self) -> None:
+        self.inner.run()
+
+    def run_in_thread(self):
+        t = threading.Thread(target=self.run, daemon=True)
+        t.start()
+        return t
+
+    def stop(self) -> None:
+        self.inner.stop()
+
+    def __getattr__(self, name):
+        # transport extras (await_peers, drop_connection, bus, ...)
+        # resolve against the wrapped backend; __dict__ lookup avoids
+        # recursing before __init__ assigned self.inner
+        inner = self.__dict__.get("inner")
+        if inner is None:
+            raise AttributeError(name)
+        return getattr(inner, name)

@@ -53,6 +53,17 @@ def record_handle(msg_type: str, seconds: float,
         t.observe("comm.handle_latency_s", seconds, msg_type=msg_type)
 
 
+def record_unhandled(msg_type: str,
+                     telemetry: Optional[Telemetry] = None) -> None:
+    """A frame arrived for a message type the node has no handler for —
+    a late/stray/duplicate frame, expected under faults.  Counted on the
+    same registry chaos runs read (``faults.observed`` naming), so
+    injected drops/delays can be reconciled against what nodes saw."""
+    t = telemetry or get_telemetry()
+    t.inc("comm.unhandled_msgs", 1, msg_type=msg_type)
+    t.inc("faults.observed", 1, kind="unhandled_msg", msg_type=msg_type)
+
+
 def _value_nbytes(v) -> float:
     """Approximate serialized size of one params value (see message.py
     codecs) WITHOUT encoding it — inproc skips serialization entirely,

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""Chaos soak driver: run a fault matrix over the multi-process TCP
+federation and record per-scenario outcomes.
+
+Each scenario spawns the REAL process topology (hub + server + N client
+OS processes over sockets, ``experiments/distributed_fedavg.launch``)
+and injects one failure mode; the federation must survive to the final
+round with a finite global model.  Default matrix:
+
+    fault_free           no injection — the accuracy baseline
+    client_crash         a SAMPLED client os._exit()s at round 1
+                         (SIGKILL semantics: no FINISH, dangling socket)
+    hub_restart          the hub is SIGKILLed mid-run and restarted on
+                         the same port; every worker must re-dial
+    drop30               every client's model frames (send+recv) drop
+                         with p=0.3 (seeded ``FaultPlan`` via the
+                         FEDML_TPU_CHAOS env)
+    straggler_deadline   one client sleeps past the round deadline
+                         every round — permanently dropped
+    corrupt_payload      one client's uploads are NaN-corrupted every
+                         round; the server must reject them pre-
+                         aggregation
+
+Per scenario the output records: survived, rounds completed, rounds
+aggregated empty (``zero_participant_rounds``), degraded rounds,
+rejected uploads, fault counters (server process + hub), final test
+accuracy and its delta vs the fault-free arm, and a NaN check over the
+final global model.
+
+Usage (CPU is fine — this is a protocol soak, not a perf benchmark):
+
+    python tools/chaos_run.py --matrix default --out FAULTS_r06.json
+    python tools/chaos_run.py --scenario corrupt_payload
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _worker_env():
+    env = dict(os.environ)
+    env["FEDML_TPU_FORCE_CPU"] = "1"
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = ""  # keep the children lean: no faked mesh
+    return env
+
+
+def _scenarios(round_timeout: float):
+    """name -> launch() kwargs.  Every faulted arm runs with a round
+    deadline: without one a single lost upload wedges the federation
+    forever (the exact failure mode this subsystem exists to kill)."""
+    from fedml_tpu.faults import FaultPlan, FaultRule, FaultSpec
+
+    drop_plan = FaultPlan(
+        seed=0,
+        send_spec=FaultSpec(drop_prob=0.3),
+        recv_spec=FaultSpec(drop_prob=0.3),
+        roles=("client",),
+    ).to_json()
+    corrupt_plan = FaultPlan(
+        seed=0,
+        rules=[FaultRule(action="corrupt", node=3,
+                         msg_type="C2S_SEND_MODEL", direction="send")],
+        roles=("client",),
+    ).to_json()
+    return {
+        "fault_free": {},
+        "client_crash": {
+            "crash_client_at_round": 1,
+            "round_timeout": round_timeout,
+        },
+        "hub_restart": {
+            "restart_hub_after": 1.0,
+            "auto_reconnect": 60,
+            "round_timeout": round_timeout,
+        },
+        "drop30": {
+            "chaos_plan": drop_plan,
+            "round_timeout": round_timeout,
+        },
+        "straggler_deadline": {
+            "slow_client_delay": 10 * round_timeout,
+            "round_timeout": round_timeout,
+        },
+        "corrupt_payload": {
+            "chaos_plan": corrupt_plan,
+            "round_timeout": round_timeout,
+        },
+    }
+
+
+def _final_model_eval(out_path: str, seed: int, num_clients: int):
+    """Load the server's final leaves and evaluate on the shared
+    synthetic test split (every process builds the same problem from the
+    seed, so this is the federation's real held-out accuracy)."""
+    import numpy as np
+
+    import jax
+
+    from fedml_tpu.core.client import eval_summary, make_evaluator
+    from fedml_tpu.core.types import batch_eval_pack
+    from fedml_tpu.experiments.distributed_fedavg import _build_problem
+
+    ds, bundle, init, _ = _build_problem(seed, num_clients)
+    leaves_like, treedef = jax.tree_util.tree_flatten(init)
+    z = np.load(out_path)
+    leaves = [np.asarray(z[f"leaf_{i}"]) for i in range(len(leaves_like))]
+    nan_free = bool(all(np.isfinite(l).all() for l in leaves))
+    variables = jax.tree_util.tree_unflatten(treedef, leaves)
+    x, y, m = batch_eval_pack(ds.test_x, ds.test_y, 32)
+    summary = eval_summary(make_evaluator(bundle)(variables, x, y, m))
+    round_log = json.loads(str(z["round_log"]))
+    return {
+        "nan_free": nan_free,
+        "final_acc": float(summary["test_acc"]),
+        "final_loss": float(summary["test_loss"]),
+        "rounds_recorded": int(z["rounds"]),
+        "round_participants": [
+            r.get("participants") for r in round_log if "participants" in r
+        ],
+    }
+
+
+def run_scenario(name: str, kwargs: dict, *, num_clients: int, rounds: int,
+                 seed: int, timeout: float) -> dict:
+    from fedml_tpu.experiments.distributed_fedavg import launch
+
+    out_path = os.path.join(
+        tempfile.mkdtemp(prefix=f"chaos_{name}_"), "final.npz"
+    )
+    info: dict = {}
+    t0 = time.time()
+    print(f"== scenario {name} ==", flush=True)
+    try:
+        rc = launch(
+            num_clients=num_clients, rounds=rounds, seed=seed,
+            batch_size=16, out_path=out_path, env=_worker_env(),
+            info=info, timeout=timeout, **kwargs,
+        )
+    except Exception as e:  # harness failure IS a scenario failure
+        return {"scenario": name, "survived": False,
+                "error": f"{type(e).__name__}: {e}",
+                "wall_s": round(time.time() - t0, 1)}
+    rec = {
+        "scenario": name,
+        "survived": rc == 0,
+        "rc": rc,
+        "rounds": info.get("rounds"),
+        "rounds_aggregated_empty": info.get("zero_participant_rounds"),
+        "rounds_degraded": info.get("rounds_degraded"),
+        "rejected_uploads": info.get("rejected_uploads"),
+        "server_fault_counters": info.get("faults") or {},
+        "hub_stats": info.get("hub_stats") or {},
+        "wall_s": round(time.time() - t0, 1),
+    }
+    if os.path.exists(out_path):
+        try:
+            rec.update(_final_model_eval(out_path, seed, num_clients))
+        except Exception as e:
+            rec["eval_error"] = f"{type(e).__name__}: {e}"
+            rec["nan_free"] = False
+    print(f"   -> rc={rc} acc={rec.get('final_acc')} "
+          f"empty_rounds={rec.get('rounds_aggregated_empty')} "
+          f"({rec['wall_s']}s)", flush=True)
+    return rec
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--matrix", default="default", choices=["default"])
+    p.add_argument("--scenario", default="",
+                   help="run one scenario by name instead of the matrix")
+    p.add_argument("--out", default="FAULTS_r06.json")
+    p.add_argument("--num-clients", type=int, default=3)
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--round-timeout", type=float, default=20.0,
+                   help="per-round deadline for the faulted arms; must "
+                        "exceed a client's cold jit+train time on the "
+                        "host (~5-10 s on a loaded 1-core CI box)")
+    p.add_argument("--timeout", type=float, default=240.0,
+                   help="per-scenario hard cap on the server process")
+    args = p.parse_args(argv)
+
+    scenarios = _scenarios(args.round_timeout)
+    if args.scenario:
+        if args.scenario not in scenarios:
+            print(f"unknown scenario {args.scenario!r}; "
+                  f"have {sorted(scenarios)}", file=sys.stderr)
+            return 2
+        scenarios = {args.scenario: scenarios[args.scenario]}
+
+    results = []
+    for name, kwargs in scenarios.items():
+        results.append(run_scenario(
+            name, kwargs, num_clients=args.num_clients, rounds=args.rounds,
+            seed=args.seed, timeout=args.timeout,
+        ))
+
+    baseline = next(
+        (r for r in results
+         if r["scenario"] == "fault_free" and "final_acc" in r), None
+    )
+    for r in results:
+        if baseline is not None and "final_acc" in r:
+            r["acc_delta_vs_fault_free"] = round(
+                r["final_acc"] - baseline["final_acc"], 6
+            )
+
+    doc = {
+        "matrix": args.matrix if not args.scenario else args.scenario,
+        "num_clients": args.num_clients,
+        "rounds": args.rounds,
+        "seed": args.seed,
+        "round_timeout_s": args.round_timeout,
+        "generated_unix": round(time.time(), 1),
+        "scenarios": results,
+        "all_survived": all(r.get("survived") for r in results),
+        "all_nan_free": all(r.get("nan_free", False) for r in results),
+    }
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh, indent=2)
+    print(json.dumps({"out": args.out,
+                      "all_survived": doc["all_survived"],
+                      "all_nan_free": doc["all_nan_free"]}))
+    return 0 if doc["all_survived"] and doc["all_nan_free"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -105,9 +105,18 @@ def summarize(records: List[dict]) -> dict:
     comm: Dict[str, dict] = {}
     gauges: Dict[str, float] = {}
     compile_counters: Dict[str, float] = {}
+    faults: Dict[str, float] = {}
+    # fault/degradation series (the chaos layer's accounting): injected
+    # faults, what the tolerance layer observed, degraded rounds, and
+    # the comm-resilience counters (retries/reconnects/hub drops)
+    _FAULT_PREFIXES = ("faults.", "hub.", "rounds.")
+    _FAULT_COMM = ("comm.unhandled_msgs", "comm.send_retries",
+                   "comm.send_failed", "comm.reconnects")
     if telemetry:
         for key, value in (telemetry.get("counters") or {}).items():
             name, labels = parse_metric_key(key)
+            if name.startswith(_FAULT_PREFIXES) or name in _FAULT_COMM:
+                faults[key] = value
             if name.startswith("comm."):
                 row = comm.setdefault(labels.get("msg_type", "?"), {})
                 row[name.split(".", 1)[1]] = value
@@ -133,6 +142,19 @@ def summarize(records: List[dict]) -> dict:
                     "mean_s": hist.get("mean"),
                     "max_s": hist.get("max"),
                 }
+            elif name in ("span.reconnect_s", "span.server_round_s"):
+                # recovery spans: how long nodes were off the hub / how
+                # long the server's rounds ran open (deadline closes
+                # show up as max ~= round_timeout)
+                faults[key] = {
+                    "count": hist.get("count"),
+                    "mean_s": hist.get("mean"),
+                    "max_s": hist.get("max"),
+                }
+
+    # degraded/resume events ride the record stream (kind-tagged)
+    fault_events = [r for r in records
+                    if r.get("kind") in ("degraded_round", "resume")]
 
     return {
         "num_records": len(records),
@@ -142,6 +164,8 @@ def summarize(records: List[dict]) -> dict:
         "rounds": rounds,
         "spans": spans,
         "comm": comm,
+        "faults": faults,
+        "fault_events": fault_events,
         "compiles": [
             {k: c.get(k) for k in ("ts", "fn", "signature", "seconds")}
             for c in compiles
@@ -228,6 +252,20 @@ def render_text(path: str, s: dict, max_round_rows: int = 30) -> None:
                   f"signature#{c.get('signature')}  {_fmt_s(c.get('seconds'))}")
         for key in sorted(s["compile_counters"]):
             print(f"    {key} = {s['compile_counters'][key]:g}")
+
+    if s.get("faults") or s.get("fault_events"):
+        print("\n  faults / degradation:")
+        for key in sorted(s.get("faults") or {}):
+            v = s["faults"][key]
+            if isinstance(v, dict):
+                print(f"    {key}: count={v.get('count')} "
+                      f"mean={_fmt_s(v.get('mean_s'))} "
+                      f"max={_fmt_s(v.get('max_s'))}")
+            else:
+                print(f"    {key} = {v:g}")
+        for ev in s.get("fault_events") or []:
+            extra = {k: v for k, v in ev.items() if k not in ("kind", "ts")}
+            print(f"    event {ev.get('kind')}: {extra}")
 
     if s["gauges"]:
         print("\n  gauges:")

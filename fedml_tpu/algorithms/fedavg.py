@@ -52,6 +52,12 @@ class ServerState(NamedTuple):
     opt_state: Any
     round_idx: jax.Array
     key: jax.Array
+    # error-feedback residual store (update-compression subsystem,
+    # ``fedml_tpu/compress``): a [num_clients, ...] pytree of fp32
+    # per-client quantization residuals, () when compression/EF is off.
+    # Lives IN the round state so the fused scans carry it and
+    # checkpoint/resume stays bit-identical under compression.
+    residuals: Any = ()
 
 
 def default_server_update(old, agg, opt_state):
@@ -83,6 +89,8 @@ def make_round_fn(
     axis_name: Optional[str] = None,
     client_axis_impl: str = "map",
     client_unroll: int = 1,
+    codec=None,
+    error_feedback: bool = False,
 ):
     """Build the per-round function over a packed client block.
 
@@ -101,7 +109,29 @@ def make_round_fn(
     next to small per-client bodies) — trades compiled-code size for
     fewer loop iterations, like the step-scan ``unroll`` inside
     ``make_local_update``.
+
+    ``codec`` (a ``fedml_tpu.compress`` LeafCodec) simulates the lossy
+    uplink INSIDE the compiled round: each client's update
+    ``delta = trained - global`` goes through ``decode(encode(delta))``
+    before aggregation — bit-identical to what a real transport would
+    reconstruct, because the wire form runs the same jnp encode
+    (``compress/codecs.py``).  Compression randomness is the third
+    fold_in sub-stream of the round key (train=0, agg noise=1,
+    compress=2), keyed per GLOBAL slot id, so R fused rounds stay
+    bit-equal to R dispatched rounds.  ``error_feedback`` threads the
+    EF recurrence through ``state.residuals`` ([num_clients, ...] fp32
+    store, gathered/scattered by slot id) — required for convergence
+    with biased codecs (top-k) and tighter tracking for quantizers.
     """
+    if error_feedback and axis_name is not None:
+        raise ValueError(
+            "error_feedback is not defined under shard_map (axis_name="
+            f"{axis_name!r}): the residual store is gathered by GLOBAL "
+            "slot id, which a device-local block cannot index; compress "
+            "on the host path instead"
+        )
+    if codec is not None:
+        from fedml_tpu.compress import COMPRESS_STREAM, roundtrip_tree
 
     def round_fn(state: ServerState, x, y, mask, num_samples, participation, slot_ids):
         # slot_ids are GLOBAL client slot indices — under shard_map each
@@ -131,6 +161,60 @@ def make_round_fn(
             client_vars, client_metrics = jax.lax.map(
                 lambda args: run_one(*args), (x, y, mask, client_rngs)
             )
+
+        residuals = state.residuals
+        if codec is not None:
+            # lossy uplink: what the server aggregates is the DECODED
+            # update, exactly what the wire form reconstructs.  EF folds
+            # the per-client residual in before encoding and keeps the
+            # new quantization error for the next round (participation-
+            # masked: a client that did not report keeps its residual).
+            k_comp = jax.random.fold_in(k_round, COMPRESS_STREAM)
+            comp_rngs = jax.vmap(
+                lambda i: jax.random.fold_in(k_comp, i)
+            )(slot_ids)
+            f32 = jnp.float32
+
+            def lossy_one(cvars, rng, res_row):
+                delta = jax.tree_util.tree_map(
+                    lambda c, g: c.astype(f32) - g.astype(f32),
+                    cvars, state.variables,
+                )
+                if error_feedback:
+                    delta = jax.tree_util.tree_map(jnp.add, delta, res_row)
+                dec = roundtrip_tree(codec, delta, rng)
+                new_cvars = jax.tree_util.tree_map(
+                    lambda g, d: (g.astype(f32) + d).astype(g.dtype),
+                    state.variables, dec,
+                )
+                new_res = (
+                    jax.tree_util.tree_map(jnp.subtract, delta, dec)
+                    if error_feedback else ()
+                )
+                return new_cvars, new_res
+
+            if error_feedback:
+                res_rows = jax.tree_util.tree_map(
+                    lambda r: r[slot_ids], state.residuals
+                )
+                client_vars, res_new = jax.vmap(lossy_one)(
+                    client_vars, comp_rngs, res_rows
+                )
+                keep = lambda new, old: jnp.where(
+                    participation.reshape(
+                        (-1,) + (1,) * (new.ndim - 1)
+                    ) > 0,
+                    new, old,
+                )
+                res_rows = jax.tree_util.tree_map(keep, res_new, res_rows)
+                residuals = jax.tree_util.tree_map(
+                    lambda store, rows: store.at[slot_ids].set(rows),
+                    state.residuals, res_rows,
+                )
+            else:
+                client_vars, _ = jax.vmap(
+                    lambda c, r: lossy_one(c, r, None)
+                )(client_vars, comp_rngs)
 
         weights = participation * num_samples  # sample-weighted, masked
         if aggregate_transform is not None:
@@ -187,6 +271,7 @@ def make_round_fn(
             opt_state=new_opt,
             round_idx=state.round_idx + 1,
             key=state.key,
+            residuals=residuals,
         )
         return new_state, train_metrics
 
@@ -393,6 +478,13 @@ class FedAvgConfig:
     # drops mid-round with this probability; masked-psum aggregation
     # excludes them exactly (tests/test_fedavg.py)
     drop_prob: float = 0.0
+    # update compression (fedml_tpu/compress): codec name for the lossy
+    # uplink simulated inside the compiled round — "int8"/"qsgd8",
+    # "int4"/"qsgd4", "bf16", "topk<rate>"; None/"" = fp32 (off).
+    # compress_ef threads the error-feedback residual store through
+    # ServerState (REQUIRED for topk; recommended for quantizers).
+    compress_codec: Optional[str] = None
+    compress_ef: bool = False
 
 
 class FedAvgSimulation:
@@ -450,6 +542,24 @@ class FedAvgSimulation:
         )
         self._server_update = server_update
         self._aggregate_transform = aggregate_transform
+        # update compression (lossy uplink inside the compiled round):
+        # resolve the codec once; subclasses that build their OWN round
+        # kernel (FedNova, FedNAS) have no compression stage — refuse
+        # loudly rather than silently training uncompressed
+        from fedml_tpu.compress import encoded_nbytes, get_codec
+
+        self._codec = get_codec(config.compress_codec)
+        self._codec_ef = bool(config.compress_ef) and self._codec is not None
+        if (
+            self._codec is not None
+            and type(self)._build_round_fn
+            is not FedAvgSimulation._build_round_fn
+        ):
+            raise ValueError(
+                f"{type(self).__name__} builds its own round kernel; "
+                "compress_codec is only wired through the base FedAvg "
+                "kernel (make_round_fn)"
+            )
         # compile-event tracking per jit signature (obs layer): a cohort
         # geometry that varies per round shows up as jax.compiles{fn=
         # round_fn} climbing instead of sitting at 1-2 (recompile storm)
@@ -467,11 +577,23 @@ class FedAvgSimulation:
         key = jax.random.PRNGKey(config.seed)
         variables = bundle.init(key)
         opt_state = server_opt_init(variables) if server_opt_init else ()
+        # EF residual store: one fp32 row per client (zeros at round 0);
+        # sized by the FULL population so sampled cohorts gather/scatter
+        # their rows by global slot id
+        residuals = ()
+        if self._codec_ef:
+            residuals = jax.tree_util.tree_map(
+                lambda l: jnp.zeros(
+                    (config.num_clients,) + tuple(np.shape(l)), jnp.float32
+                ),
+                variables,
+            )
         self.state = ServerState(
             variables=variables,
             opt_state=opt_state,
             round_idx=jnp.zeros((), jnp.int32),
             key=key,
+            residuals=residuals,
         )
         # fixed pack geometry across rounds → one compilation
         self.steps_per_epoch = cohort_steps_per_epoch(
@@ -501,6 +623,12 @@ class FedAvgSimulation:
             * int(getattr(getattr(l, "dtype", None), "itemsize", 4) or 4)
             for l in jax.tree_util.tree_leaves(variables)
         )
+        # exact encoded payload bytes per upload (static given shapes):
+        # the compression-ratio accounting for simulated traffic
+        self._enc_nbytes = (
+            encoded_nbytes(self._codec, variables)
+            if self._codec is not None else self._model_nbytes
+        )
 
     def _build_round_fn(self):
         """Subclass hook: FedNova etc. swap in a different round kernel."""
@@ -508,6 +636,8 @@ class FedAvgSimulation:
             self.local_update,
             server_update=self._server_update,
             aggregate_transform=self._aggregate_transform,
+            codec=self._codec,
+            error_feedback=self._codec_ef,
         )
 
     # -- checkpoint/resume --------------------------------------------------
@@ -632,8 +762,16 @@ class FedAvgSimulation:
         t.inc("comm.sent_bytes", self._model_nbytes * down,
               msg_type="S2C_SYNC_MODEL")
         t.inc("comm.recv_msgs", up, msg_type="C2S_SEND_MODEL")
-        t.inc("comm.recv_bytes", self._model_nbytes * up,
+        # uplink bytes follow the codec: a compressed round's C2S
+        # traffic is the exact encoded payload size, and the raw/
+        # compressed counter pair feeds trace_summary's ratio section
+        t.inc("comm.recv_bytes", self._enc_nbytes * up,
               msg_type="C2S_SEND_MODEL")
+        if self._codec is not None:
+            t.inc("comm.raw_bytes", self._model_nbytes * up,
+                  msg_type="C2S_SEND_MODEL")
+            t.inc("comm.compressed_bytes", self._enc_nbytes * up,
+                  msg_type="C2S_SEND_MODEL")
 
     def run_round(self) -> dict:
         round_idx = int(self.state.round_idx)

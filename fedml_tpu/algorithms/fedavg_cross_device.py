@@ -38,9 +38,16 @@ from fedml_tpu.comm.message import (
     MSG_TYPE_S2C_SYNC_MODEL,
     Message,
     tree_from_wire,
+    tree_is_delta,
     tree_to_wire,
 )
 from fedml_tpu.core import tree as treelib
+
+# envelope key for codec negotiation: the server announces the uplink
+# codec on S2C_INIT_CONFIG/S2C_SYNC_MODEL; clients echo it on their
+# C2S_SEND_MODEL wiretree ("codec" + "delta" inside the wire pytree).
+# Absent key = legacy fp32 full-model uploads — old peers interop.
+MSG_ARG_KEY_CODEC = "codec"
 from fedml_tpu.core.client import LocalUpdateFn
 from fedml_tpu.core.types import FedDataset, pack_clients
 from fedml_tpu.obs.telemetry import get_telemetry
@@ -99,8 +106,19 @@ class FedAvgServerManager(NodeManager):
         steps_per_epoch: Optional[int] = None,
         round_timeout: Optional[float] = None,
         spares: int = 0,
+        codec: str = "none",
     ):
         import threading
+
+        from fedml_tpu.compress import get_codec
+
+        # uplink compression negotiation: broadcast messages carry the
+        # codec name; clients encode their update delta with it and the
+        # server decodes before the exact fp32 weighted average (the
+        # renormalization over realized reporters is unchanged — decode
+        # happens BEFORE aggregation, so the average itself stays exact)
+        self.codec_name = codec or "none"
+        self._codec = get_codec(self.codec_name)
 
         # cohort-wide pack geometry: shipped to clients so a client's
         # fixed-shape pack is IDENTICAL to its slice of the simulation's
@@ -206,6 +224,8 @@ class FedAvgServerManager(NodeManager):
         m.add_params(MSG_ARG_KEY_CLIENT_INDEX, node - 1)
         m.add_params(MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
         m.add_params("slot", slot)  # global client id → rng stream id (matches SPMD slot_ids)
+        if self._codec is not None:
+            m.add_params(MSG_ARG_KEY_CODEC, self.codec_name)
         if self.steps_per_epoch is not None:
             m.add_params("steps_per_epoch", self.steps_per_epoch)
         return m
@@ -228,14 +248,26 @@ class FedAvgServerManager(NodeManager):
         with self._round_lock:
             if self._is_stale(msg, reply_round):
                 return
+            # delta uploads reconstruct against the model THIS round
+            # broadcast — capture it under the lock (a concurrent round
+            # close would swap self.variables; the post-decode stale
+            # re-check then discards anything decoded against it)
+            base = self.variables
         # decode + validate OUTSIDE the round lock: both are O(model)
         # (multi-MB b64 decode, full-tree finite scan) and K near-
         # simultaneous uploads would otherwise serialize behind one
         # lock with the deadline timer blocked at the back of the queue
         try:
-            variables = tree_from_wire(
-                msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.variables
-            )
+            payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+            variables = tree_from_wire(payload, self.variables)
+            if tree_is_delta(payload):
+                # codec-encoded UPDATE: decoded leaves are fp32 deltas;
+                # the upload's model is base + delta (what the client's
+                # error-feedback recurrence assumes the server sees)
+                variables = jax.tree_util.tree_map(
+                    lambda b, d: np.asarray(b, np.float32) + d,
+                    base, variables,
+                )
         except Exception:
             # an undecodable payload (truncated/garbled frame) is a
             # fault observation, not a server crash
@@ -414,6 +446,7 @@ class FedAvgClientManager(NodeManager):
         seed: int = 0,
         train_delay: float = 0.0,
         crash_at_round: Optional[int] = None,
+        error_feedback: bool = True,
     ):
         self.local_update = jax.jit(local_update.fn)
         self.dataset = dataset
@@ -421,6 +454,17 @@ class FedAvgClientManager(NodeManager):
         self.template = template_variables
         self.seed = seed
         self.rounds_trained = 0
+        # uplink compression (server-negotiated via the sync's codec
+        # key): EF keeps the per-round quantization error and folds it
+        # into the next update — on by default for lossy codecs
+        self.error_feedback = error_feedback
+        self._ef = None
+        # sha256 over every encoded upload's payload buffers, in send
+        # order — the reproducibility probe a federation re-run compares
+        # (same seed => identical digest)
+        import hashlib
+
+        self._upload_hash = hashlib.sha256()
         # artificial pre-training sleep: straggler injection for the
         # server's round-deadline path (tests/test_distributed_process)
         self.train_delay = train_delay
@@ -476,12 +520,73 @@ class FedAvgClientManager(NodeManager):
         reply = Message(MSG_TYPE_C2S_SEND_MODEL, self.backend.node_id, SERVER)
         # echo the round: the server rejects uploads from closed rounds
         reply.add_params(MSG_ARG_KEY_ROUND_INDEX, round_idx)
-        reply.add_params(MSG_ARG_KEY_MODEL_PARAMS, tree_to_wire(new_vars))
+        codec_name = msg.get(MSG_ARG_KEY_CODEC) or "none"
+        wire = self._encode_upload(
+            codec_name, new_vars, variables, round_idx, slot
+        )
+        reply.add_params(MSG_ARG_KEY_MODEL_PARAMS, wire)
         reply.add_params(MSG_ARG_KEY_NUM_SAMPLES, float(pack.num_samples[0]))
         reply.add_params(
             MSG_ARG_KEY_LOCAL_METRICS, {k: float(v) for k, v in metrics.items()}
         )
         self.send_message(reply)
+
+    def _encode_upload(self, codec_name: str, new_vars, synced_vars,
+                       round_idx: int, slot: int):
+        """Build the upload wiretree: full-precision v2 when the server
+        negotiated no codec; otherwise the codec-encoded DELTA
+        (trained - synced), with the EF residual folded in and the new
+        quantization error kept for the next round.  The encode key is
+        the engine's exact compression stream —
+        ``fold_in(fold_in(fold_in(seed_key, round), COMPRESS_STREAM),
+        slot)`` — so encoded bytes are a pure function of
+        (seed, round, slot): bit-identical across processes and re-runs.
+        """
+        from fedml_tpu.compress import (
+            COMPRESS_STREAM,
+            ErrorFeedback,
+            get_codec,
+            wire_tree_digest,
+        )
+        from fedml_tpu.obs import comm_obs
+
+        codec = get_codec(codec_name)
+        if codec is None:
+            return tree_to_wire(new_vars)
+        delta = jax.tree_util.tree_map(
+            lambda n, s: np.asarray(n, np.float32)
+            - np.asarray(s, np.float32),
+            new_vars, synced_vars,
+        )
+        if self.error_feedback:
+            if self._ef is None:
+                self._ef = ErrorFeedback()
+            delta = self._ef.fold_in(delta)
+        k_round = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed), round_idx
+        )
+        key = jax.random.fold_in(
+            jax.random.fold_in(k_round, COMPRESS_STREAM), slot
+        )
+        wire = tree_to_wire(delta, codec=codec, key=key, delta=True)
+        if self.error_feedback:
+            self._ef.absorb(delta, tree_from_wire(wire, self.template))
+        raw = sum(
+            int(np.asarray(l).size) * 4
+            for l in jax.tree_util.tree_leaves(delta)
+        )
+        comp = sum(
+            int(np.asarray(v).nbytes)
+            for leaf in wire["leaves"] for v in leaf["enc"].values()
+        )
+        comm_obs.record_compression(MSG_TYPE_C2S_SEND_MODEL, raw, comp)
+        self._upload_hash.update(wire_tree_digest(wire).encode())
+        return wire
+
+    @property
+    def upload_digest(self) -> str:
+        """Accumulated sha256 of every encoded upload this client sent."""
+        return self._upload_hash.hexdigest()
 
     def _on_finish(self, msg: Message):
         self.finish()

@@ -10,15 +10,27 @@ On TPU the data plane (weights) rides XLA collectives; this Message
 layer is the HOST control plane for loosely-coupled/cross-device modes
 (the reference's MQTT role) and for the inproc simulation backend.
 Arrays are encoded as nested lists (the reference's
-``transform_tensor_to_list`` codec, ``fedavg/utils.py:5-14``) or as
-base64 float32 buffers — the compact default.
+``transform_tensor_to_list`` codec, ``fedavg/utils.py:5-14``), as
+base64 float32 buffers (wiretree v1, the legacy default), or — the
+compact default since the compression subsystem — as **wiretree v2**:
+raw numpy leaves that the frame codec (``to_frame``/``from_frame``)
+ships as length-prefixed binary buffers after a one-line JSON header,
+killing the 4/3x base64 inflation even for uncompressed traffic.  A v2
+wiretree may additionally carry a ``codec`` name (``fedml_tpu.compress``
+registry) and a ``delta`` flag: its leaves are then per-leaf codec
+encodings of a model UPDATE rather than raw parameters.
+
+Interop contract (pinned by ``tests/test_compress.py``): v1 frames
+(b64 JSON lines) still decode everywhere, and a v2 wiretree serialized
+through the legacy JSON path (``to_json``) degrades gracefully — its
+raw leaves b64-encode like any array and decode back.
 """
 
 from __future__ import annotations
 
 import base64
 import json
-from typing import Any, Dict
+from typing import Any, Dict, List
 
 import numpy as np
 
@@ -77,6 +89,35 @@ class Message:
     def to_json(self) -> str:
         return json.dumps(self.params, default=_encode_value)
 
+    def to_frame(self) -> bytes:
+        """Binary wire frame: one JSON header line, then raw buffers.
+
+        Every array value (at any nesting depth) is lifted out of the
+        JSON into a concatenated binary payload and replaced by an
+        ``{"__ndbuf__": [offset, nbytes], dtype, shape}`` reference;
+        the header's top-level ``__binlen__`` key carries the payload
+        length so readers (hub, backend) know exactly how many raw
+        bytes follow the newline.  Messages without arrays serialize
+        to a plain JSON line — readable and v1-identical.
+        """
+        bufs: List[bytes] = []
+        header = _extract_buffers(self.params, bufs, [0])
+        if not bufs:
+            return (self.to_json() + "\n").encode()
+        payload = b"".join(bufs)
+        header[FRAME_BINLEN_KEY] = len(payload)
+        return (
+            json.dumps(header, default=_encode_value).encode()
+            + b"\n" + payload
+        )
+
+    @classmethod
+    def from_frame(cls, header_obj: dict, payload: bytes = b"") -> "Message":
+        """Inverse of ``to_frame`` given the parsed header line and the
+        raw payload bytes that followed it."""
+        obj = {k: v for k, v in header_obj.items() if k != FRAME_BINLEN_KEY}
+        return cls.from_obj(_inject_buffers(obj, payload))
+
     @classmethod
     def from_json(cls, payload: str) -> "Message":
         return cls.from_obj(json.loads(payload))
@@ -95,29 +136,100 @@ class Message:
 
 # --- pytree <-> wire codecs -------------------------------------------------
 
-def tree_to_wire(tree: Any) -> Any:
-    """Pytree of arrays → JSON-able nested structure with b64 buffers."""
+FRAME_BINLEN_KEY = "__binlen__"
+
+
+def tree_to_wire(tree: Any, *, version: int = 2, codec=None, key=None,
+                 delta: bool = False) -> Any:
+    """Pytree of arrays → wire structure.
+
+    ``version=2`` (default): raw numpy leaves, shipped as binary
+    buffers by the frame codec (or b64 by the legacy JSON path).
+    ``version=1``: the legacy b64 leaf dicts.  With ``codec`` (a
+    ``fedml_tpu.compress`` LeafCodec) leaves are codec encodings of a
+    model UPDATE, seeded by ``key`` — always a v2 wiretree; ``delta``
+    marks the payload as an update to add to a base model rather than
+    full parameters (the receiver checks this flag).
+    """
     import jax
 
+    if codec is not None:
+        from fedml_tpu.compress import wire_encode_tree
+
+        return {
+            "__wiretree__": 2,
+            "codec": codec.name,
+            "delta": bool(delta),
+            "leaves": wire_encode_tree(codec, tree, key),
+        }
     leaves, _ = jax.tree_util.tree_flatten(tree)
+    if version == 1:
+        return {
+            "__wiretree__": 1,
+            "leaves": [_encode_array(np.asarray(l)) for l in leaves],
+        }
     return {
-        "__wiretree__": 1,
-        "leaves": [_encode_array(np.asarray(l)) for l in leaves],
+        "__wiretree__": 2,
+        "leaves": [np.ascontiguousarray(np.asarray(l)) for l in leaves],
     }
 
 
+def tree_codec_name(obj: Any) -> str:
+    """Codec a wire pytree was encoded with ('' = uncompressed)."""
+    return obj.get("codec", "") if isinstance(obj, dict) else ""
+
+
+def tree_is_delta(obj: Any) -> bool:
+    """True when the wire pytree carries a model UPDATE (add to base)."""
+    return bool(obj.get("delta")) if isinstance(obj, dict) else False
+
+
 def tree_from_wire(obj: Any, like: Any) -> Any:
-    """Decode against a structural template ``like`` (same treedef)."""
+    """Decode against a structural template ``like`` (same treedef).
+
+    Handles every wire generation: v1 b64 leaf dicts, v2 raw arrays
+    (or ``__ndbuf__``-injected views), a v2 tree that traveled the
+    legacy JSON path (its raw leaves b64-rewrapped), and codec-encoded
+    v2 trees (decoded to fp32 via the named codec — the caller applies
+    the ``delta`` semantics).
+    """
     import jax
 
+    name = tree_codec_name(obj)
+    if name and name != "none":
+        from fedml_tpu.compress import get_codec, wire_decode_tree
+
+        entries = [
+            {**e, "enc": {k: (_decode_array(v)
+                              if isinstance(v, dict) and "__ndarray__" in v
+                              else np.asarray(v))
+                          for k, v in e["enc"].items()}}
+            for e in obj["leaves"]
+        ]
+        return wire_decode_tree(get_codec(name), entries, like)
     leaves_like, treedef = jax.tree_util.tree_flatten(like)
-    leaves = [_decode_array(e) for e in obj["leaves"]]
+    leaves = [
+        _decode_array(e) if isinstance(e, dict) and "__ndarray__" in e
+        else np.asarray(e)
+        for e in obj["leaves"]
+    ]
     assert len(leaves) == len(leaves_like), "wire/treedef leaf count mismatch"
     leaves = [
         np.asarray(l, dtype=np.asarray(ref).dtype).reshape(np.asarray(ref).shape)
         for l, ref in zip(leaves, leaves_like)
     ]
     return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """np.dtype with the ml_dtypes extras (bfloat16 etc.) registered —
+    numpy alone rejects 'bfloat16' unless ml_dtypes was imported."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
 
 
 def _encode_array(a: np.ndarray) -> dict:
@@ -130,7 +242,53 @@ def _encode_array(a: np.ndarray) -> dict:
 
 def _decode_array(obj: dict) -> np.ndarray:
     buf = base64.b64decode(obj["__ndarray__"])
-    return np.frombuffer(buf, dtype=np.dtype(obj["dtype"])).reshape(obj["shape"])
+    return np.frombuffer(buf, dtype=_np_dtype(obj["dtype"])).reshape(obj["shape"])
+
+
+def _is_raw_array(v) -> bool:
+    """A real array (numpy or jax) — NOT a numpy scalar, which stays an
+    inline JSON number."""
+    if isinstance(v, np.generic):
+        return False
+    return isinstance(v, np.ndarray) or (
+        hasattr(v, "dtype") and hasattr(v, "shape") and hasattr(v, "nbytes")
+    )
+
+
+def _extract_buffers(v, bufs: List[bytes], offset: List[int]):
+    """Deep-copy ``v`` with every raw array replaced by an
+    ``__ndbuf__`` reference; the array bytes append to ``bufs``."""
+    if _is_raw_array(v):
+        a = np.ascontiguousarray(np.asarray(v))
+        b = a.tobytes()
+        ref = {
+            "__ndbuf__": [offset[0], len(b)],
+            "dtype": str(a.dtype),
+            "shape": list(a.shape),
+        }
+        bufs.append(b)
+        offset[0] += len(b)
+        return ref
+    if isinstance(v, dict):
+        return {k: _extract_buffers(x, bufs, offset) for k, x in v.items()}
+    if isinstance(v, (list, tuple)):
+        return [_extract_buffers(x, bufs, offset) for x in v]
+    return v
+
+
+def _inject_buffers(v, payload: bytes):
+    """Inverse of ``_extract_buffers``: materialize ``__ndbuf__``
+    references as (read-only) numpy views into ``payload``."""
+    if isinstance(v, dict):
+        if "__ndbuf__" in v:
+            off, n = v["__ndbuf__"]
+            return np.frombuffer(
+                payload[off:off + n], dtype=_np_dtype(v["dtype"])
+            ).reshape(v["shape"])
+        return {k: _inject_buffers(x, payload) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_inject_buffers(x, payload) for x in v]
+    return v
 
 
 def _encode_value(v):
@@ -165,11 +323,25 @@ def tensor_to_list(tree: Any) -> Any:
     return jax.tree_util.tree_map(lambda a: np.asarray(a).tolist(), tree)
 
 
-def list_to_tensor(tree: Any) -> Any:
+def list_to_tensor(tree: Any, like: Any = None) -> Any:
+    """Inverse of ``tensor_to_list``.  With ``like`` (a structural
+    template) each leaf is cast to the TEMPLATE's dtype, so bf16/int
+    leaves survive a list-codec wire round-trip; without it, the
+    legacy float32 cast is preserved (the reference's mobile codec
+    assumed f32 throughout)."""
     import jax
 
+    if like is None:
+        return jax.tree_util.tree_map(
+            lambda l: np.asarray(l, dtype=np.float32),
+            tree,
+            is_leaf=lambda x: isinstance(x, list),
+        )
     return jax.tree_util.tree_map(
-        lambda l: np.asarray(l, dtype=np.float32),
+        lambda l, ref: np.asarray(
+            l, dtype=np.asarray(ref).dtype
+        ).reshape(np.shape(ref)),
         tree,
+        like,
         is_leaf=lambda x: isinstance(x, list),
     )

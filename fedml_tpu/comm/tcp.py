@@ -5,9 +5,16 @@ Fills the role the reference gives MQTT
 JSON payloads for loosely-coupled mobile clients) with zero external
 dependencies: a hub process accepts connections, each node registers its
 integer id (hub ACKs the registration — sends before the ACK cannot
-race past an unregistered receiver), and JSON-lines frames are routed
-by receiver id.  Weights ride the Message codec (base64 f32 buffers, or
-the reference's list-codec via ``tensor_to_list`` for mobile parity).
+race past an unregistered receiver), and frames are routed by receiver
+id.  Two frame generations share the stream:
+
+- **v1** — one JSON line per message (arrays as base64 f32 buffers, or
+  the reference's list-codec via ``tensor_to_list`` for mobile parity);
+- **v2** (default) — a JSON header line whose top-level ``__binlen__``
+  key announces exactly that many raw payload bytes following the
+  newline (``Message.to_frame``).  Readers that see no ``__binlen__``
+  treat the line as a complete v1 frame, so both generations interop on
+  one hub and the 4/3x base64 inflation is gone from model traffic.
 
 Design notes vs the reference's MPI threads (SURVEY.md §5.2): one
 blocking reader thread per connection, shutdown via sentinel frame and
@@ -27,7 +34,7 @@ import time
 from typing import Dict
 
 from fedml_tpu.comm.backend import CommBackend
-from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.message import FRAME_BINLEN_KEY, Message
 from fedml_tpu.obs.telemetry import get_telemetry
 
 _SENTINEL = {"__hub__": "stop"}
@@ -92,7 +99,26 @@ class TcpHub:
                 try:
                     frame = json.loads(line)
                 except json.JSONDecodeError:
-                    continue  # drop malformed frame, keep the connection
+                    # a garbled header is fatal for the CONNECTION, not
+                    # just the frame: since frames may carry binary
+                    # payloads, the stream cannot resynchronize — the
+                    # "bytes" that follow could be an unannounced
+                    # payload whose tail would parse as bogus headers
+                    # (worst case: a fabricated __binlen__ blocks this
+                    # thread on bytes that never arrive).  Dropping the
+                    # conn costs the peer one reconnect (its retry/
+                    # auto_reconnect path), never a wedged router.
+                    break
+                # v2 binary frame: the header announces exactly how many
+                # raw payload bytes follow — read them here so routing
+                # forwards header+payload as ONE unit and the readline
+                # loop never parses payload bytes as lines
+                payload = b""
+                binlen = frame.get(FRAME_BINLEN_KEY)
+                if binlen:
+                    payload = f.read(binlen)
+                    if len(payload) < binlen:
+                        break  # peer died mid-payload: torn frame == EOF
                 if frame.get("__hub__") == "peers":
                     # membership introspection: reply to THIS node with
                     # the currently registered ids (startup barrier —
@@ -109,7 +135,7 @@ class TcpHub:
                     break
                 receiver = frame.get("receiver")
                 if receiver is not None:
-                    self._forward(receiver, line,
+                    self._forward(receiver, line + payload,
                                   msg_type=frame.get("msg_type"))
         except OSError:
             pass  # peer vanished: fall through to cleanup
@@ -135,9 +161,11 @@ class TcpHub:
             return
         try:
             with send_lock:
-                conn.sendall(
-                    raw_line if raw_line.endswith(b"\n") else raw_line + b"\n"
-                )
+                # raw_line is a COMPLETE frame: a header line read by
+                # readline (always \n-terminated) plus, for v2, exactly
+                # __binlen__ payload bytes — appending anything to a
+                # binary frame would desync the receiver's payload read
+                conn.sendall(raw_line)
         except OSError:
             # dead receiver: unregister so later sends don't retry it;
             # its own reader thread finishes cleanup
@@ -187,10 +215,15 @@ class TcpBackend(CommBackend):
 
     def __init__(self, node_id: int, host: str, port: int,
                  timeout: float = 30.0, auto_reconnect: int = 0,
-                 send_retries: int = 3):
+                 send_retries: int = 3, wire: int = 2):
         super().__init__(node_id)
         self._host, self._port, self._timeout = host, port, timeout
         self.auto_reconnect = auto_reconnect
+        # wire generation for OUTBOUND frames: 2 = binary v2 frames
+        # (Message.to_frame), 1 = legacy JSON lines (b64 arrays) — the
+        # baseline arm of the compression measurement and the interop
+        # test knob.  Inbound frames of either generation always decode.
+        self.wire = int(wire)
         # bounded retry budget for send_message: a transient OSError
         # (hub restarting, conn mid-swap by the reconnect path) used to
         # be terminal for the SENDER even though the reader thread was
@@ -243,18 +276,26 @@ class TcpBackend(CommBackend):
             self._sock, self._file = sock, f
 
     def send_message(self, msg: Message) -> None:
-        # to_json() is already one valid JSON line (newlines escape inside
-        # JSON strings) — no re-parse needed
+        # v2: header line + raw buffers (to_frame); v1: one JSON line
+        # (newlines escape inside JSON strings) — either way ONE bytes
+        # object, sent atomically under the send lock
         t0 = time.perf_counter()
-        data = (msg.to_json() + "\n").encode()
+        if self.wire >= 2:
+            data = msg.to_frame()
+        else:
+            data = (msg.to_json() + "\n").encode()
         # Bounded retry with exponential backoff + jitter: each attempt
         # re-reads self._sock, so a reconnect (reader thread's _dial
         # swapping the socket) between attempts is picked up.  A retry
-        # after a PARTIAL sendall can hand the hub a garbled first line
-        # — the hub drops malformed frames, so the worst case is one
-        # lost frame (the round deadline's job), never stream corruption.
-        # A backend killed by _kill_connection must not retry: the
-        # stream is desync-fatal by contract and callers expect OSError.
+        # after a PARTIAL sendall hands the hub a garbled header line —
+        # the hub treats that as fatal for the CONNECTION (frames may
+        # carry binary payloads, so a garbled boundary cannot be
+        # resynchronized) and drops it; this node's reader then sees
+        # EOF and the auto_reconnect/round-deadline machinery covers
+        # the lost frame.  Never stream corruption, at worst one
+        # reconnect.  A backend killed by _kill_connection must not
+        # retry: the stream is desync-fatal by contract and callers
+        # expect OSError.
         delay = 0.05
         for attempt in range(self.send_retries + 1):
             try:
@@ -367,8 +408,34 @@ class TcpBackend(CommBackend):
         retries = self.auto_reconnect
         lost_at = None  # perf_counter stamp of the FIRST EOF of an outage
         while not self._stopped.is_set():
+            frame = None
+            payload = b""
             try:
                 line = self._file.readline()
+                if line:
+                    try:
+                        frame = json.loads(line)
+                    except json.JSONDecodeError:
+                        # same contract as the hub: a garbled header on
+                        # a stream that may carry binary payloads means
+                        # the frame boundary is lost — treat as EOF so
+                        # the reconnect path re-dials a frame-aligned
+                        # stream instead of parsing payload bytes as
+                        # headers
+                        logging.warning(
+                            "node %d: malformed frame header — "
+                            "dropping connection", self.node_id,
+                        )
+                        line, frame = b"", None
+                    binlen = (frame.get(FRAME_BINLEN_KEY)
+                              if isinstance(frame, dict) else None)
+                    if binlen:
+                        payload = self._file.read(binlen)
+                        if len(payload) < binlen:
+                            # torn frame: the hub died mid-payload — the
+                            # stream can't be trusted, treat as EOF (the
+                            # reconnect path re-dials a fresh one)
+                            line, frame = b"", None
             except OSError:
                 line = b""
             if not line:
@@ -403,15 +470,12 @@ class TcpBackend(CommBackend):
                         "node %d: reconnect failed", self.node_id
                     )
                     continue  # retry until the budget runs out
-            try:
-                frame = json.loads(line)
-            except json.JSONDecodeError:
-                logging.exception("node %d: dropping malformed frame", self.node_id)
-                continue
             if frame.get("__hub__") == "stop":
                 return
             try:
-                self._notify(Message.from_obj(frame), nbytes=len(line))
+                # exact wire bytes: header line + binary payload
+                self._notify(Message.from_frame(frame, payload),
+                             nbytes=len(line) + len(payload))
             except Exception:
                 # a handler error must not kill the reader thread — the
                 # node would silently stop receiving and the federation

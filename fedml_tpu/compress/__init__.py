@@ -1,0 +1,44 @@
+"""Update-compression subsystem: deterministic codecs + error feedback.
+
+See ``codecs.py`` for the codec registry (qsgd8/qsgd4/topk/bf16) and
+``error_feedback.py`` for the host-side EF recurrence.  Wire format
+integration lives in ``fedml_tpu/comm/message.py`` (wiretree v2);
+compiled-engine integration in ``fedml_tpu/algorithms/fedavg.py``
+(``make_round_fn(codec=..., error_feedback=...)``).
+"""
+
+from fedml_tpu.compress.codecs import (
+    COMPRESS_STREAM,
+    Bf16Codec,
+    IdentityCodec,
+    LeafCodec,
+    QsgdCodec,
+    TopKCodec,
+    decode_tree,
+    encode_tree,
+    encoded_nbytes,
+    get_codec,
+    roundtrip_tree,
+    wire_decode_tree,
+    wire_encode_tree,
+    wire_tree_digest,
+)
+from fedml_tpu.compress.error_feedback import ErrorFeedback
+
+__all__ = [
+    "COMPRESS_STREAM",
+    "Bf16Codec",
+    "ErrorFeedback",
+    "IdentityCodec",
+    "LeafCodec",
+    "QsgdCodec",
+    "TopKCodec",
+    "decode_tree",
+    "encode_tree",
+    "encoded_nbytes",
+    "get_codec",
+    "roundtrip_tree",
+    "wire_decode_tree",
+    "wire_encode_tree",
+    "wire_tree_digest",
+]

@@ -1,0 +1,356 @@
+"""Deterministic update codecs: quantization, sparsification, bf16.
+
+Cross-device FL's production bottleneck at the reference's scale
+(342k-client StackOverflow row) is uplink BYTES, not FLOPs — and the
+wire until now shipped every update as float32 inflated 4/3x by base64
+(``comm/message.py`` v1).  This module provides the lossy half of the
+fix: three composable update codecs from the communication-efficiency
+lineage the paper sits in (Konečný et al. 2016 structured updates;
+QSGD, Alistarh et al. 2017 stochastic quantization):
+
+- ``qsgd8`` / ``qsgd4`` (aliases ``int8`` / ``int4``) — QSGD-style
+  stochastic uniform quantization with per-chunk max-abs scales.
+  Unbiased per element (``E[decode(encode(x))] == x``), worst-case
+  per-element error ``chunk_max / levels``.
+- ``topk<rate>`` (e.g. ``topk0.01``) — magnitude top-k sparsification:
+  indices + exact values, everything else zero.  Biased; REQUIRES
+  error feedback to converge.
+- ``bf16`` — bfloat16 cast (deterministic, ~2x, no rng).
+- ``none`` — identity (fp32 passthrough; the control arm).
+
+Determinism contract (the PR-3 chaos-trace reproducibility contract
+extended to payload bytes): every stochastic draw derives from the
+caller's ``jax.random`` key via ``fold_in`` — no process RNG, no wall
+clock — so the same (seed, round, slot) stream produces BIT-identical
+encoded buffers in any process (pinned by
+``tests/test_compress.py::test_encode_bits_identical_across_processes``).
+
+Two forms per codec, sharing ONE implementation:
+
+- on-device: ``encode(x, key)`` / ``decode(enc, shape, dtype)`` are
+  pure jnp functions, jit/vmap-compatible (static shapes — chunk
+  counts and top-k widths derive from leaf shapes), usable inside the
+  compiled round engine (``fedml_tpu.algorithms.fedavg.make_round_fn``);
+- wire: ``wire_encode_tree`` / ``wire_decode_tree`` run the same
+  functions and materialize numpy arrays for the wiretree-v2 frame
+  codec (``comm/message.py``), plus int4 nibble-packing that only
+  exists on the wire.
+
+Error feedback (EF): ``residual = update - decode(encode(update))``
+carried by the CALLER across rounds and folded into the next update
+before encoding — the standard fix for the bias of lossy codecs.  The
+engine threads it through ``ServerState.residuals``; the cross-device
+client keeps a host-side copy (``fedavg_cross_device``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+PyTree = Any
+
+# sub-stream index for compression randomness under the round key:
+# fold_in(k_round, 0) = training, 1 = aggregation noise (make_round_fn),
+# 2 = update compression — per-client keys then fold in the GLOBAL slot
+# id, so streams never collide across uses or devices
+COMPRESS_STREAM = 2
+
+_CHUNK = 256  # per-chunk scale granularity (fp32 scale per 256 values)
+
+
+def _f32(x):
+    import jax.numpy as jnp
+
+    return x.astype(jnp.float32)
+
+
+class LeafCodec:
+    """One leaf's encode/decode pair.  ``encode`` returns a flat dict of
+    arrays (the encoded payload); ``decode`` reconstructs the leaf from
+    it given the (static) original shape.  Both are jnp-pure."""
+
+    name: str = "?"
+    stochastic: bool = False  # True: encode consumes the rng key
+
+    def encode(self, x, key) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def decode(self, enc: Dict[str, Any], shape: Tuple[int, ...]):
+        raise NotImplementedError
+
+    # wire hooks: pack/unpack numpy payloads (default: passthrough)
+    def wire_pack(self, enc: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        return enc
+
+    def wire_unpack(self, enc: Dict[str, np.ndarray],
+                    shape: Tuple[int, ...]) -> Dict[str, np.ndarray]:
+        return enc
+
+
+class IdentityCodec(LeafCodec):
+    name = "none"
+
+    def encode(self, x, key):
+        del key
+        return {"v": _f32(x).reshape(-1)}
+
+    def decode(self, enc, shape):
+        return enc["v"].reshape(shape)
+
+
+class Bf16Codec(LeafCodec):
+    name = "bf16"
+
+    def encode(self, x, key):
+        import jax.numpy as jnp
+
+        del key
+        return {"v": _f32(x).reshape(-1).astype(jnp.bfloat16)}
+
+    def decode(self, enc, shape):
+        return _f32(enc["v"]).reshape(shape)
+
+
+class QsgdCodec(LeafCodec):
+    """QSGD stochastic uniform quantization, per-chunk max-abs scale.
+
+    ``q = floor(x / scale * L + u)`` with ``u ~ U[0, 1)`` is unbiased
+    for both signs (``E[floor(y + u)] = y``); values land in
+    ``[-L, L]`` and ship as int8 (int4 packs two per byte on the wire).
+    A zero chunk (scale 0) encodes to zeros via a safe divisor.
+    """
+
+    stochastic = True
+
+    def __init__(self, bits: int):
+        assert bits in (4, 8)
+        self.bits = bits
+        self.name = f"qsgd{bits}"
+        self.levels = 7 if bits == 4 else 127
+
+    def encode(self, x, key):
+        import jax
+        import jax.numpy as jnp
+
+        flat = _f32(x).reshape(-1)
+        n = flat.shape[0]
+        m = -(-n // _CHUNK)  # ceil chunks
+        pad = m * _CHUNK - n
+        chunks = jnp.pad(flat, (0, pad)).reshape(m, _CHUNK)
+        scale = jnp.max(jnp.abs(chunks), axis=1)  # [m]
+        safe = jnp.where(scale > 0, scale, 1.0)
+        y = chunks / safe[:, None] * self.levels  # in [-L, L]
+        u = jax.random.uniform(key, chunks.shape)
+        q = jnp.clip(jnp.floor(y + u), -self.levels, self.levels)
+        # truncate to the true length: padded tail bytes are pure waste
+        # on the wire (a 7-element leaf must not cost a 256-byte chunk)
+        return {"q": q.astype(jnp.int8).reshape(-1)[:n], "scale": scale}
+
+    def decode(self, enc, shape):
+        import jax.numpy as jnp
+
+        n = 1
+        for d in shape:
+            n *= d
+        m = -(-n // _CHUNK)
+        q = jnp.pad(_f32(enc["q"]), (0, m * _CHUNK - n)).reshape(m, _CHUNK)
+        scale = _f32(enc["scale"])
+        out = q * (scale[:, None] / self.levels)
+        return out.reshape(-1)[:n].reshape(shape)
+
+    # -- int4 wire packing: two values per byte ------------------------------
+    def wire_pack(self, enc):
+        if self.bits != 4:
+            return enc
+        q = np.asarray(enc["q"], np.int8)
+        u = (q.astype(np.int16) + 8).astype(np.uint8)  # [-7,7] -> [1,15]
+        if u.size % 2:
+            u = np.concatenate([u, np.zeros(1, np.uint8)])
+        packed = ((u[0::2] << 4) | u[1::2]).astype(np.uint8)
+        return {"q4": packed, "scale": np.asarray(enc["scale"]),
+                "qn": np.asarray(q.size, np.int64)}
+
+    def wire_unpack(self, enc, shape):
+        if self.bits != 4 or "q4" not in enc:
+            return enc
+        packed = np.asarray(enc["q4"], np.uint8)
+        qn = int(enc["qn"])
+        u = np.empty(packed.size * 2, np.uint8)
+        u[0::2] = packed >> 4
+        u[1::2] = packed & 0x0F
+        q = (u[:qn].astype(np.int16) - 8).astype(np.int8)
+        return {"q": q, "scale": np.asarray(enc["scale"])}
+
+
+class TopKCodec(LeafCodec):
+    """Magnitude top-k: ``k = max(1, round(rate * size))`` largest-|x|
+    entries ship as (int32 index, fp32 value); decode scatters into
+    zeros.  Deterministic (no rng).  Biased — run with error feedback."""
+
+    def __init__(self, rate: float):
+        if not 0.0 < rate <= 1.0:
+            raise ValueError(f"topk rate must be in (0, 1], got {rate}")
+        self.rate = rate
+        self.name = f"topk{rate:g}"
+
+    def _k(self, n: int) -> int:
+        return max(1, min(n, int(round(self.rate * n))))
+
+    def encode(self, x, key):
+        import jax
+        import jax.numpy as jnp
+
+        del key
+        flat = _f32(x).reshape(-1)
+        k = self._k(flat.shape[0])
+        _, idx = jax.lax.top_k(jnp.abs(flat), k)
+        idx = jnp.sort(idx)  # canonical order: stable wire bytes
+        return {"idx": idx.astype(jnp.int32), "val": flat[idx]}
+
+    def decode(self, enc, shape):
+        import jax.numpy as jnp
+
+        n = 1
+        for d in shape:
+            n *= d
+        zeros = jnp.zeros((n,), jnp.float32)
+        return zeros.at[enc["idx"]].set(_f32(enc["val"])).reshape(shape)
+
+
+def get_codec(name: Optional[str]) -> Optional[LeafCodec]:
+    """Codec registry: ``none``/''/None, ``bf16``, ``int8``/``qsgd8``,
+    ``int4``/``qsgd4``, ``topk<rate>`` (default rate 0.01)."""
+    if name is None or name in ("", "none", "fp32"):
+        return None
+    if name == "bf16":
+        return Bf16Codec()
+    if name in ("int8", "qsgd8"):
+        return QsgdCodec(8)
+    if name in ("int4", "qsgd4"):
+        return QsgdCodec(4)
+    if name.startswith("topk"):
+        rate = name[len("topk"):]
+        return TopKCodec(float(rate) if rate else 0.01)
+    raise ValueError(
+        f"unknown codec {name!r} (known: none, bf16, int8/qsgd8, "
+        "int4/qsgd4, topk<rate>)"
+    )
+
+
+# --- tree-level plumbing (shared by engine and wire) ------------------------
+
+def _leaf_keys(key, num_leaves: int):
+    import jax
+
+    return [jax.random.fold_in(key, i) for i in range(num_leaves)]
+
+
+def encode_tree(codec: LeafCodec, tree: PyTree, key) -> List[Dict[str, Any]]:
+    """Encode every leaf; returns encodings aligned to
+    ``jax.tree_util.tree_leaves(tree)`` order."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    return [codec.encode(l, k)
+            for l, k in zip(leaves, _leaf_keys(key, len(leaves)))]
+
+
+def decode_tree(codec: LeafCodec, encs: List[Dict[str, Any]],
+                like: PyTree) -> PyTree:
+    """Decode against a structural template (shapes/treedef from
+    ``like``); every decoded leaf is fp32."""
+    import jax
+
+    leaves_like, treedef = jax.tree_util.tree_flatten(like)
+    assert len(encs) == len(leaves_like), "codec/treedef leaf count mismatch"
+    out = [codec.decode(e, tuple(np.shape(ref)))
+           for e, ref in zip(encs, leaves_like)]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def roundtrip_tree(codec: LeafCodec, tree: PyTree, key) -> PyTree:
+    """decode(encode(tree)) in one call — the engine's lossy view of an
+    update (what the server will reconstruct from the wire)."""
+    return decode_tree(codec, encode_tree(codec, tree, key), tree)
+
+
+# --- wire forms (numpy payloads for wiretree v2) ----------------------------
+
+def wire_encode_tree(codec: LeafCodec, tree: PyTree, key) -> List[dict]:
+    """Per-leaf wire entries: ``{"enc": {name: np.ndarray}, "shape",
+    "dtype"}`` — raw arrays, so the v2 frame codec ships them as
+    length-prefixed binary buffers (no base64)."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    out = []
+    for l, k in zip(leaves, _leaf_keys(key, len(leaves))):
+        enc = codec.encode(l, k)
+        enc_np = {name: np.asarray(v) for name, v in enc.items()}
+        out.append({
+            "enc": codec.wire_pack(enc_np),
+            "shape": list(np.shape(l)),
+            "dtype": str(np.asarray(l).dtype),
+        })
+    return out
+
+
+def wire_decode_tree(codec: LeafCodec, entries: List[dict],
+                     like: PyTree) -> PyTree:
+    """Inverse of ``wire_encode_tree`` (numpy, host-side): decodes each
+    leaf to fp32 in the template's treedef."""
+    import jax
+
+    leaves_like, treedef = jax.tree_util.tree_flatten(like)
+    assert len(entries) == len(leaves_like), "wire/treedef leaf count mismatch"
+    out = []
+    for e, ref in zip(entries, leaves_like):
+        shape = tuple(e.get("shape") or np.shape(ref))
+        enc = {name: np.asarray(v) for name, v in e["enc"].items()}
+        dec = codec.decode(codec.wire_unpack(enc, shape), shape)
+        out.append(np.asarray(dec, np.float32))
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def encoded_nbytes(codec: Optional[LeafCodec], tree: PyTree) -> int:
+    """Exact wire payload bytes of the encoded tree (buffers only, no
+    envelope) — static given shapes, so drivers can account compressed
+    traffic without re-encoding every round."""
+    import jax
+
+    if codec is None:
+        return sum(int(np.prod(np.shape(l), dtype=np.int64)) * 4
+                   for l in jax.tree_util.tree_leaves(tree))
+    key = _dummy_key()
+    total = 0
+    for entry in wire_encode_tree(codec, tree, key):
+        total += sum(int(np.asarray(v).nbytes)
+                     for v in entry["enc"].values())
+    return total
+
+
+def _dummy_key():
+    import jax
+
+    return jax.random.PRNGKey(0)
+
+
+def wire_tree_digest(wire_obj: dict) -> str:
+    """sha256 over a wiretree's payload buffers in leaf order — the
+    reproducibility probe: two runs at the same seed must produce
+    IDENTICAL encoded uploads, and this digest is how a federation run
+    proves it without capturing multi-MB frames."""
+    h = hashlib.sha256()
+    for leaf in wire_obj.get("leaves", ()):
+        if isinstance(leaf, dict) and "enc" in leaf:
+            for name in sorted(leaf["enc"]):
+                h.update(np.ascontiguousarray(
+                    np.asarray(leaf["enc"][name])).tobytes())
+        elif isinstance(leaf, dict) and "__ndarray__" in leaf:
+            h.update(str(leaf["__ndarray__"]).encode())
+        else:
+            h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
+    return h.hexdigest()

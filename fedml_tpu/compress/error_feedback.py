@@ -1,0 +1,60 @@
+"""Error-feedback residual state (host side).
+
+Lossy codecs bias the aggregate: what the server reconstructs is
+``decode(encode(update))``, and the per-round quantization/sparsification
+error would otherwise be lost forever (top-k without EF simply never
+ships small coordinates).  Error feedback (Seide et al. 2014; Karimireddy
+et al. 2019) keeps the error: the client carries
+
+    residual_{t+1} = (update_t + residual_t) - decode(encode(update_t + residual_t))
+
+and folds it into the NEXT round's update, so every coordinate is
+eventually transmitted and convergence matches the uncompressed run to
+first order.
+
+This class is the host-side form used by the cross-device client
+(``fedavg_cross_device.FedAvgClientManager``); the compiled engine
+threads the same recurrence through ``ServerState.residuals`` on device
+(``fedml_tpu.algorithms.fedavg.make_round_fn``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+PyTree = Any
+
+
+class ErrorFeedback:
+    """Residual accumulator for ONE participant's update stream."""
+
+    def __init__(self):
+        self._residual: Optional[PyTree] = None
+
+    def fold_in(self, delta: PyTree) -> PyTree:
+        """``delta + residual`` (fp32); identity on the first round."""
+        import jax
+
+        if self._residual is None:
+            return jax.tree_util.tree_map(
+                lambda d: np.asarray(d, np.float32), delta
+            )
+        return jax.tree_util.tree_map(
+            lambda d, r: np.asarray(d, np.float32) + r,
+            delta, self._residual,
+        )
+
+    def absorb(self, folded: PyTree, decoded: PyTree) -> None:
+        """Store ``folded - decoded`` — the error the wire dropped."""
+        import jax
+
+        self._residual = jax.tree_util.tree_map(
+            lambda f, d: np.asarray(f, np.float32)
+            - np.asarray(d, np.float32),
+            folded, decoded,
+        )
+
+    def reset(self) -> None:
+        self._residual = None

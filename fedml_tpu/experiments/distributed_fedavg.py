@@ -45,7 +45,11 @@ def _force_cpu_if_requested():
         jax.config.update("jax_platforms", "cpu")
 
 
-def _build_problem(seed: int, num_clients: int):
+def _build_problem(seed: int, num_clients: int, input_dim: int = 8):
+    """``input_dim`` scales the model (logistic_regression(input_dim, 2))
+    so byte-accounting runs can measure compression on a payload large
+    enough that the frame envelope is noise (the default 18-param model
+    is all envelope)."""
     import jax
 
     from fedml_tpu.core.client import make_client_optimizer, make_local_update
@@ -53,24 +57,24 @@ def _build_problem(seed: int, num_clients: int):
     from fedml_tpu.models.linear import logistic_regression
 
     ds = synthetic_classification(
-        num_train=60 * num_clients, num_test=30, input_shape=(8,),
+        num_train=60 * num_clients, num_test=30, input_shape=(input_dim,),
         num_classes=2, num_clients=num_clients, partition="homo", seed=seed,
     )
-    bundle = logistic_regression(8, 2)
+    bundle = logistic_regression(input_dim, 2)
     init = bundle.init(jax.random.PRNGKey(seed))
     lu = make_local_update(bundle, make_client_optimizer("sgd", 0.1), 1)
     return ds, bundle, init, lu
 
 
 def _connect_backend(node_id: int, host: str, port: int, retries: int = 50,
-                     auto_reconnect: int = 0):
+                     auto_reconnect: int = 0, wire: int = 2):
     """The hub may still be binding when a worker starts: retry."""
     from fedml_tpu.comm.tcp import TcpBackend
 
     for attempt in range(retries):
         try:
             return TcpBackend(node_id, host, port,
-                              auto_reconnect=auto_reconnect)
+                              auto_reconnect=auto_reconnect, wire=wire)
         except (ConnectionError, OSError):
             if attempt == retries - 1:
                 raise
@@ -151,10 +155,12 @@ def run_server(args) -> None:
 
     from fedml_tpu.algorithms.fedavg_cross_device import FedAvgServerManager
 
-    ds, bundle, init, lu = _build_problem(args.seed, args.num_clients)
+    ds, bundle, init, lu = _build_problem(args.seed, args.num_clients,
+                                          args.input_dim)
     backend = _maybe_chaos(
         _connect_backend(0, args.host, args.port,
-                         auto_reconnect=max(args.auto_reconnect, 0)),
+                         auto_reconnect=max(args.auto_reconnect, 0),
+                         wire=args.wire),
         "server",
     )
     # cohort-wide pack geometry (fedavg_cross_device.py:62-66): each
@@ -181,6 +187,7 @@ def run_server(args) -> None:
         steps_per_epoch=steps,
         round_timeout=args.round_timeout or None,
         spares=args.spares,
+        codec=args.codec,
     )
     # startup barrier: the hub drops frames to unregistered receivers,
     # so broadcasting before every client registered would hang
@@ -214,6 +221,14 @@ def run_server(args) -> None:
                    if k.startswith(("faults.", "comm.unhandled",
                                     "comm.send_retries", "comm.send_failed",
                                     "comm.reconnects"))},
+        # exact server-side wire accounting (TcpBackend counts header +
+        # binary payload): the compression measurement reads C2S bytes
+        # off this line across baseline/compressed federations
+        "comm_bytes": {k: v for k, v in snap.items()
+                       if k.startswith(("comm.recv_bytes",
+                                        "comm.sent_bytes",
+                                        "comm.recv_msgs",
+                                        "comm.sent_msgs"))},
     }), flush=True)
     if server.zero_participant_rounds >= server.comm_rounds:
         # every round aggregated nobody (deadline shorter than client
@@ -229,7 +244,8 @@ def run_client(args) -> None:
     _force_cpu_if_requested()
     from fedml_tpu.algorithms.fedavg_cross_device import FedAvgClientManager
 
-    ds, bundle, init, lu = _build_problem(args.seed, args.num_clients)
+    ds, bundle, init, lu = _build_problem(args.seed, args.num_clients,
+                                          args.input_dim)
     # clients ride out transient hub-connection drops: re-dial +
     # re-register, rejoining as a straggler for the missed round (the
     # server's round deadline covers the gap)
@@ -239,10 +255,10 @@ def run_client(args) -> None:
     reconnect = args.auto_reconnect if args.auto_reconnect >= 0 else 3
     backend = _maybe_chaos(
         _connect_backend(args.node_id, args.host, args.port,
-                         auto_reconnect=reconnect),
+                         auto_reconnect=reconnect, wire=args.wire),
         "client", plan,
     )
-    FedAvgClientManager(
+    mgr = FedAvgClientManager(
         backend, lu, ds, batch_size=args.batch_size,
         template_variables=init, seed=args.seed,
         train_delay=args.train_delay,
@@ -251,6 +267,13 @@ def run_client(args) -> None:
         ),
     )
     backend.run()  # returns on FINISH
+    # reproducibility probe: the accumulated sha256 of every encoded
+    # upload — two runs at the same seed must print identical digests
+    # (the launcher collects these when asked)
+    print(json.dumps({
+        f"client_{args.node_id}_upload_digest": mgr.upload_digest,
+        f"client_{args.node_id}_rounds_trained": mgr.rounds_trained,
+    }), flush=True)
 
 
 def launch(
@@ -271,6 +294,9 @@ def launch(
     spares: int = 0,
     auto_reconnect: int = 0,
     chaos_plan: str = "",
+    codec: str = "none",
+    wire: int = 2,
+    input_dim: int = 8,
     info=None,
     env=None,
     server_env=None,
@@ -330,6 +356,12 @@ def launch(
         common = ["--host", "127.0.0.1", "--port", str(port),
                   "--num-clients", str(num_clients), "--rounds", str(rounds),
                   "--seed", str(seed), "--batch-size", str(batch_size)]
+        if codec and codec != "none":
+            common += ["--codec", codec]
+        if wire != 2:
+            common += ["--wire", str(wire)]
+        if input_dim != 8:
+            common += ["--input-dim", str(input_dim)]
         if round_timeout:
             common += ["--round-timeout", str(round_timeout)]
         if clients_per_round:
@@ -350,6 +382,10 @@ def launch(
                    if crash_client_at_round >= 0 and i == num_clients - 1
                    else []),
                 env=env,
+                # client stdout carries the upload-digest JSON line the
+                # compression measurement compares across re-runs
+                stdout=subprocess.PIPE if info is not None else None,
+                text=True if info is not None else None,
             )
             for i in range(num_clients)
         ]
@@ -436,6 +472,8 @@ def launch(
                 # chaos a client whose FINISH was lost blocks forever —
                 # reap it (the server outcome is what the caller asserts)
                 c.kill()
+            if info is not None:
+                _collect_json_lines(c.stdout, info)
         if extra_idle_clients:
             assert killed_registered_peer
         return rc
@@ -476,6 +514,14 @@ def main(argv=None):
     p.add_argument("--spares", type=int, default=0)
     p.add_argument("--auto-reconnect", type=int, default=-1)
     p.add_argument("--crash-at-round", type=int, default=-1)
+    # update-compression knobs (fedml_tpu/compress): the server
+    # announces --codec on every sync; clients encode their delta with
+    # it.  --wire 1 forces legacy JSON/base64 frames (the baseline arm
+    # of the bytes measurement); --input-dim scales the model so byte
+    # ratios measure payload, not envelope.
+    p.add_argument("--codec", default="none")
+    p.add_argument("--wire", type=int, choices=[1, 2], default=2)
+    p.add_argument("--input-dim", type=int, default=8)
     args = p.parse_args(argv)
     if args.role == "hub":
         run_hub(args.host, args.port)

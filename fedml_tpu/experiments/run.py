@@ -89,6 +89,12 @@ class ExperimentConfig:
     # beyond-reference knobs available on the FedAvg-engine family
     compute_dtype: str = ""  # "bf16" = mixed-precision local training
     drop_prob: float = 0.0  # failure injection: P(client dies mid-round)
+    # update compression (fedml_tpu/compress; FedAvg-engine family):
+    # lossy uplink codec simulated inside the compiled round —
+    # int8/qsgd8, int4/qsgd4, bf16, topk<rate>; "" = off.  compress_ef
+    # threads the error-feedback residual store (required for topk).
+    compress: str = ""
+    compress_ef: int = 0
     # the reference's CIFAR-family loaders augment UNCONDITIONALLY
     # (crop+flip, +Cutout(16) for cifar10/100 — cifar10/data_loader.py:
     # 57-99, cifar100:85-91, cinic10:91-92); 0 disables for ablations
@@ -551,6 +557,8 @@ def _dispatch(cfg: ExperimentConfig, log_fn, metrics, t0) -> dict:
         frequency_of_the_test=cfg.frequency_of_the_test, seed=cfg.seed,
         compute_dtype=cfg.compute_dtype or None,
         drop_prob=cfg.drop_prob,
+        compress_codec=cfg.compress or None,
+        compress_ef=bool(cfg.compress_ef),
     )
     if cfg.algorithm == "fedavg":
         sim = fa.FedAvgSimulation(bundle, ds, fa.FedAvgConfig(**common),

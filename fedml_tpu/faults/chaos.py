@@ -44,6 +44,40 @@ from fedml_tpu.faults.plan import FaultPlan
 from fedml_tpu.obs.telemetry import get_telemetry
 
 
+def _is_float_dtype(dt: np.dtype) -> bool:
+    """True for any dtype that can hold a NaN: native floats (kind 'f')
+    AND the ml_dtypes extras (bfloat16 etc. register as kind 'V')."""
+    return dt.kind == "f" or dt.name.startswith(("bfloat16", "float8"))
+
+
+def _nan_leaf_twin(leaf) -> Optional[object]:
+    """NaN-filled COPY of one wiretree leaf, or None if the leaf holds
+    no float payload.  Handles every wire generation: v1 b64 dicts, v2
+    raw arrays, and codec entries (whose float sub-arrays — scales /
+    values — NaN-fill, so the decoded update is non-finite and the
+    server's corrupt-upload firewall fires exactly as for raw faults)."""
+    from fedml_tpu.comm.message import _np_dtype
+
+    if isinstance(leaf, dict) and "__ndarray__" in leaf:
+        dt = _np_dtype(leaf.get("dtype", "float32"))
+        if not _is_float_dtype(dt):
+            return None
+        bad = np.full(leaf.get("shape") or (), np.nan, dtype=dt)
+        return {**leaf, "__ndarray__": base64.b64encode(bad.tobytes()).decode()}
+    if isinstance(leaf, dict) and "enc" in leaf:
+        enc = leaf["enc"]
+        for name, arr in enc.items():
+            a = np.asarray(arr)
+            if _is_float_dtype(a.dtype):
+                return {**leaf,
+                        "enc": {**enc, name: np.full_like(a, np.nan)}}
+        return None
+    a = np.asarray(leaf) if hasattr(leaf, "dtype") else None
+    if a is not None and _is_float_dtype(a.dtype):
+        return np.full_like(a, np.nan)
+    return None
+
+
 def corrupt_message(msg: Message, rng) -> Optional[Message]:
     """Copy-on-write payload corruption: NaN-fill one float leaf of the
     first wire pytree found in the params (the model payload).  Returns
@@ -54,20 +88,14 @@ def corrupt_message(msg: Message, rng) -> Optional[Message]:
         if not (isinstance(value, dict) and "__wiretree__" in value):
             continue
         leaves = value.get("leaves") or []
-        float_idx = [
-            i for i, l in enumerate(leaves)
-            if isinstance(l, dict) and "__ndarray__" in l
-            and np.dtype(l.get("dtype", "float32")).kind == "f"
-        ]
-        if not float_idx:
+        twins = [(i, t) for i, t in
+                 ((i, _nan_leaf_twin(l)) for i, l in enumerate(leaves))
+                 if t is not None]
+        if not twins:
             continue
-        i = float_idx[rng.randrange(len(float_idx))]
-        leaf = dict(leaves[i])
-        bad = np.full(leaf.get("shape") or (),
-                      np.nan, dtype=np.dtype(leaf.get("dtype", "float32")))
-        leaf["__ndarray__"] = base64.b64encode(bad.tobytes()).decode()
+        i, twin_leaf = twins[rng.randrange(len(twins))]
         new_leaves = list(leaves)
-        new_leaves[i] = leaf
+        new_leaves[i] = twin_leaf
         twin = Message()
         twin.params = dict(msg.params)
         twin.params[key] = {**value, "leaves": new_leaves}

@@ -53,6 +53,18 @@ def record_handle(msg_type: str, seconds: float,
         t.observe("comm.handle_latency_s", seconds, msg_type=msg_type)
 
 
+def record_compression(msg_type: str, raw_nbytes: float,
+                       compressed_nbytes: float,
+                       telemetry: Optional[Telemetry] = None) -> None:
+    """A model payload was codec-encoded before send: ``raw_bytes`` is
+    the logical fp32 size, ``compressed_bytes`` the encoded payload
+    actually shipped — one counter pair, so a run's compression ratio
+    is a single division in ``tools/trace_summary.py``."""
+    t = telemetry or get_telemetry()
+    t.inc("comm.raw_bytes", raw_nbytes, msg_type=msg_type)
+    t.inc("comm.compressed_bytes", compressed_nbytes, msg_type=msg_type)
+
+
 def record_unhandled(msg_type: str,
                      telemetry: Optional[Telemetry] = None) -> None:
     """A frame arrived for a message type the node has no handler for —
@@ -64,18 +76,28 @@ def record_unhandled(msg_type: str,
     t.inc("faults.observed", 1, kind="unhandled_msg", msg_type=msg_type)
 
 
-def _value_nbytes(v) -> float:
+def _value_nbytes(v, binary: bool = False) -> float:
     """Approximate serialized size of one params value (see message.py
     codecs) WITHOUT encoding it — inproc skips serialization entirely,
-    so its byte accounting must not pay a full ``to_json`` per message."""
+    so its byte accounting must not pay a full ``to_json`` per message.
+
+    ``binary`` marks a wiretree-v2 context: raw arrays there ship as
+    exact length-prefixed buffers (``Message.to_frame``), so their
+    accounting is EXACT (nbytes + the ~48-byte ``__ndbuf__`` header
+    entry); legacy v1 values keep the base64 4/3x estimate."""
     if isinstance(v, dict):
         if "__ndarray__" in v:  # already-encoded array: b64 string length
             return len(v["__ndarray__"]) + 48
+        if "__ndbuf__" in v:  # binary buffer reference: exact
+            return float(v["__ndbuf__"][1]) + 48
         if "__wiretree__" in v:  # wire pytree: sum its encoded leaves
-            return sum(_value_nbytes(l) for l in v.get("leaves", ())) + 32
-        return sum(len(str(k)) + 4 + _value_nbytes(x) for k, x in v.items()) + 2
+            exact = v.get("__wiretree__") == 2
+            return sum(_value_nbytes(l, binary=exact)
+                       for l in v.get("leaves", ())) + 32
+        return sum(len(str(k)) + 4 + _value_nbytes(x, binary)
+                   for k, x in v.items()) + 2
     if isinstance(v, (list, tuple)):
-        return sum(_value_nbytes(x) for x in v) + 2
+        return sum(_value_nbytes(x, binary) for x in v) + 2
     if isinstance(v, str):
         return len(v) + 2
     if isinstance(v, bool) or v is None:
@@ -84,6 +106,8 @@ def _value_nbytes(v) -> float:
         return 12
     nbytes = getattr(v, "nbytes", None)  # numpy / jax array
     if nbytes is not None:
+        if binary:  # v2 frame: raw bytes + the __ndbuf__ header entry
+            return float(nbytes) + 48
         return float(nbytes) * _B64_FACTOR + 48
     return len(str(v))
 

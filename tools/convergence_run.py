@@ -272,6 +272,16 @@ def write_artifact(out, artifact, summary):
     print(f"wrote {out}: {json.dumps(summary)}")
 
 
+def cleanup_partial(out: str) -> None:
+    """Remove the crash-recovery ``.partial`` sidecar once its rows are
+    merged into the FINAL artifact: a stale sidecar outliving its merge
+    shadows the merged rows for the NEXT resumed session (the repo root
+    carried three such orphans before this existed)."""
+    partial = out + ".partial"
+    if os.path.exists(partial):
+        os.remove(partial)
+
+
 def run_northstar_once(partition, args, log_prefix):
     import jax
 
@@ -553,6 +563,7 @@ def main():
         t: {"final": r["final_test_acc"], "rtt": r["rounds_to_target"],
             "s_per_round": r["wall_clock_per_round_s"]}
         for t, r in runs.items()})
+    cleanup_partial(args.out)
 
 
 def run_cross_device(args):
@@ -1188,6 +1199,7 @@ def run_sampled_preset(args, spec):
     write_artifact(out, artifact,
                    {"final_test_acc": artifact["final_test_acc"],
                     "rounds_to_target": artifact["rounds_to_target"]})
+    cleanup_partial(out)
 
 
 if __name__ == "__main__":

@@ -156,6 +156,18 @@ def summarize(records: List[dict]) -> dict:
     fault_events = [r for r in records
                     if r.get("kind") in ("degraded_round", "resume")]
 
+    # compression ratios: the comm.raw_bytes / comm.compressed_bytes
+    # counter pair the compress subsystem records per message type
+    compression = {}
+    for mt, row in comm.items():
+        raw, comp = row.get("raw_bytes"), row.get("compressed_bytes")
+        if raw and comp:
+            compression[mt] = {
+                "raw_bytes": raw,
+                "compressed_bytes": comp,
+                "ratio": raw / comp,
+            }
+
     return {
         "num_records": len(records),
         "num_rounds": len(rounds),
@@ -164,6 +176,7 @@ def summarize(records: List[dict]) -> dict:
         "rounds": rounds,
         "spans": spans,
         "comm": comm,
+        "compression": compression,
         "faults": faults,
         "fault_events": fault_events,
         "compiles": [
@@ -244,6 +257,14 @@ def render_text(path: str, s: dict, max_round_rows: int = 30) -> None:
                 f"{_fmt_s(lat.get('p50_le_s')):>10}"
                 f"{_fmt_s(lat.get('p99_le_s')):>10}"
             )
+
+    if s.get("compression"):
+        print("\n  compression (per message type):")
+        for mt in sorted(s["compression"]):
+            row = s["compression"][mt]
+            print(f"    {mt:<20}raw {_fmt_bytes(row['raw_bytes']):>14}"
+                  f"  wire {_fmt_bytes(row['compressed_bytes']):>14}"
+                  f"  ratio {row['ratio']:>6.2f}x")
 
     if s["compiles"] or s["compile_counters"]:
         print("\n  compile events:")

@@ -107,6 +107,8 @@ class FedAvgServerManager(NodeManager):
         round_timeout: Optional[float] = None,
         spares: int = 0,
         codec: str = "none",
+        multicast: bool = True,
+        streaming_agg: bool = True,
     ):
         import threading
 
@@ -138,6 +140,19 @@ class FedAvgServerManager(NodeManager):
         self.comm_rounds = comm_rounds
         self.seed = seed
         self.round_idx = 0
+        # wire hot-path knobs (both default ON; the legacy settings are
+        # the measurement baseline arm and the old-peer compat mode):
+        # - multicast: ONE shared sync envelope per round fanned out by
+        #   the transport (hub mcast frame on tcp, per-receiver clones
+        #   elsewhere) instead of K per-node re-encoded unicasts;
+        # - streaming_agg: uploads fold into a running (sum n·model,
+        #   sum n) accumulator on arrival — pending holds metadata
+        #   only, peak memory O(model) instead of O(K·model), and the
+        #   close-time aggregation stall collapses to one normalize.
+        self.multicast = bool(multicast)
+        self.streaming_agg = bool(streaming_agg)
+        self._agg_acc = None
+        self._agg_n = 0.0
         self.pending: Dict[int, dict] = {}
         self.round_log = []
         self.round_timeout = round_timeout
@@ -159,13 +174,48 @@ class FedAvgServerManager(NodeManager):
 
     # -- protocol --
     def start(self):
-        wire = tree_to_wire(self.variables)  # encode once, fan out N times
         self._round_open_t = time.perf_counter()
-        for node in self._sampled_nodes():
-            self._send_or_log(
-                self._model_msg(MSG_TYPE_S2C_INIT_CONFIG, node, node - 1, wire)
-            )
+        self._broadcast_model(MSG_TYPE_S2C_INIT_CONFIG)
         self._arm_deadline()
+
+    def _broadcast_model(self, msg_type: str) -> None:
+        """Ship this round's model to the sampled cohort.
+
+        Multicast (default): ONE shared envelope — per-node identity
+        (client_idx/slot) is derived by each receiver from its node id,
+        so the frame bytes are identical for every node and the
+        transport serializes them exactly once (``send_multicast``;
+        native hub fan-out on tcp wire>=2, per-receiver clones of the
+        same payload objects elsewhere).  A transport error after the
+        backend's own bounded retries makes the WHOLE cohort stragglers
+        for this round — the deadline covers it, same contract as the
+        per-node ``_send_or_log``.
+
+        Legacy (``multicast=False``): the per-node unicast loop with
+        explicit client_idx/slot params — the measurement baseline and
+        the interop mode for pre-multicast peers that cannot derive
+        identity from their node id.
+        """
+        nodes = self._sampled_nodes()
+        wire = tree_to_wire(self.variables)  # encode once per round
+        if not self.multicast:
+            for node in nodes:
+                self._send_or_log(
+                    self._model_msg(msg_type, node, node - 1, wire)
+                )
+            return
+        msg = self._model_msg(msg_type, None, None, wire)
+        try:
+            self.backend.send_multicast(msg, nodes)
+        except OSError:
+            if self.round_timeout is None:
+                raise  # no deadline to cover the lost round: fail fast
+            get_telemetry().inc("comm.send_failed", msg_type=msg_type)
+            logging.warning(
+                "round %d: could not deliver %s multicast to %s (will "
+                "rely on the round deadline)", self.round_idx, msg_type,
+                nodes,
+            )
 
     def _arm_deadline(self):
         if self.round_timeout is None:
@@ -218,12 +268,18 @@ class FedAvgServerManager(NodeManager):
             )
         return [int(i) + 1 for i in ids]  # node id = client id + 1
 
-    def _model_msg(self, msg_type: str, node: int, slot: int, wire) -> Message:
-        m = Message(msg_type, SERVER, node)
+    def _model_msg(self, msg_type: str, node, slot, wire) -> Message:
+        """Sync envelope.  ``node=None`` builds the SHARED multicast
+        form: receiver -1, no client_idx/slot params — each client
+        derives both from its own node id (node = client + 1 and slot
+        always equaled node - 1), so one immutable frame serves the
+        whole cohort."""
+        m = Message(msg_type, SERVER, -1 if node is None else node)
         m.add_params(MSG_ARG_KEY_MODEL_PARAMS, wire)
-        m.add_params(MSG_ARG_KEY_CLIENT_INDEX, node - 1)
+        if node is not None:
+            m.add_params(MSG_ARG_KEY_CLIENT_INDEX, node - 1)
+            m.add_params("slot", slot)  # global client id → rng stream id (matches SPMD slot_ids)
         m.add_params(MSG_ARG_KEY_ROUND_INDEX, self.round_idx)
-        m.add_params("slot", slot)  # global client id → rng stream id (matches SPMD slot_ids)
         if self._codec is not None:
             m.add_params(MSG_ARG_KEY_CODEC, self.codec_name)
         if self.steps_per_epoch is not None:
@@ -288,11 +344,35 @@ class FedAvgServerManager(NodeManager):
             # K-th other reporter) while this upload was decoding
             if self._is_stale(msg, reply_round):
                 return
-            self.pending[msg.sender] = {
-                "variables": variables,
-                "n": n,
-                "metrics": msg.get(MSG_ARG_KEY_LOCAL_METRICS) or {},
-            }
+            meta = {"n": n,
+                    "metrics": msg.get(MSG_ARG_KEY_LOCAL_METRICS) or {}}
+            if msg.sender in self.pending:
+                # duplicate upload (chaos duplicate / redelivery): the
+                # buffered path overwrote the entry idempotently, but a
+                # streaming fold cannot un-fold the first copy — ignore
+                # the dupe (copies are byte-identical, so ignore ==
+                # overwrite) and count the observation in both modes
+                get_telemetry().inc("faults.observed",
+                                    kind="duplicate_upload",
+                                    msg_type=MSG_TYPE_C2S_SEND_MODEL)
+                return
+            if self.streaming_agg:
+                # fold NOW, under the round lock (a concurrent close
+                # swaps the accumulator; the stale re-check above makes
+                # this fold belong to the open round): pending keeps
+                # METADATA only, so peak memory stays O(model) however
+                # large the cohort, and the close-time aggregation
+                # stall collapses into these per-arrival folds
+                t0 = time.perf_counter()
+                self._agg_acc = treelib.tree_fold_weighted(
+                    self._agg_acc, variables, n
+                )
+                self._agg_n += float(n)
+                get_telemetry().observe("span.agg_fold_s",
+                                        time.perf_counter() - t0)
+            else:
+                meta["variables"] = variables  # legacy: buffer the tree
+            self.pending[msg.sender] = meta
             if len(self.pending) < self.clients_per_round:
                 return
             try:
@@ -331,7 +411,8 @@ class FedAvgServerManager(NodeManager):
         sampled = set(self._sampled_nodes())
         time_agg = 0.0
         entries = list(self.pending.values())
-        total = sum(e["n"] for e in entries)
+        total = (self._agg_n if self.streaming_agg
+                 else sum(e["n"] for e in entries))
         if total <= 0:
             # every reporter was rejected or weightless: same no-op
             # semantics as nobody arriving (a 0-weight average is
@@ -343,10 +424,18 @@ class FedAvgServerManager(NodeManager):
             # correction over-sampled/deadline-cut cohorts need — each
             # weight is n_i / sum(n_arrived), never n_i / sum(n_sampled)
             t0 = time.perf_counter()
-            self.variables = treelib.tree_weighted_sum(
-                [e["variables"] for e in entries],
-                [e["n"] / total for e in entries],
-            )
+            if self.streaming_agg:
+                # the whole cohort already folded in on arrival — the
+                # close "stall" is one O(model) normalize, the engine's
+                # exact num/den formulation (sum n·x then /sum n)
+                self.variables = treelib.tree_finalize_weighted_mean(
+                    self._agg_acc, total, self.variables
+                )
+            else:
+                self.variables = treelib.tree_weighted_sum(
+                    [e["variables"] for e in entries],
+                    [e["n"] / total for e in entries],
+                )
             time_agg = time.perf_counter() - t0
             # same span series the simulation drivers feed (obs layer):
             # the reference's FedAVGAggregator.py:59,85-86 aggregate timer
@@ -395,18 +484,30 @@ class FedAvgServerManager(NodeManager):
             )
         self.round_log.append(rec)
         self.pending.clear()
+        self._agg_acc, self._agg_n = None, 0.0
         self.round_idx += 1
         if self.round_idx >= self.comm_rounds:
-            for node in range(1, self.num_clients + 1):
-                self._send_or_log(Message(MSG_TYPE_S2C_FINISH, SERVER, node))
+            nodes = list(range(1, self.num_clients + 1))
+            if self.multicast:
+                try:
+                    self.backend.send_multicast(
+                        Message(MSG_TYPE_S2C_FINISH, SERVER, -1), nodes
+                    )
+                except OSError:
+                    # the federation is over either way; undelivered
+                    # FINISH frames only leave clients to their own
+                    # timeouts (same stance as _send_or_log)
+                    get_telemetry().inc("comm.send_failed",
+                                        msg_type=MSG_TYPE_S2C_FINISH)
+            else:
+                for node in nodes:
+                    self._send_or_log(
+                        Message(MSG_TYPE_S2C_FINISH, SERVER, node)
+                    )
             self.finish()
             return
-        wire = tree_to_wire(self.variables)
         self._round_open_t = time.perf_counter()
-        for node in self._sampled_nodes():
-            self._send_or_log(
-                self._model_msg(MSG_TYPE_S2C_SYNC_MODEL, node, node - 1, wire)
-            )
+        self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL)
         self._arm_deadline()
 
     def _send_or_log(self, msg: Message) -> None:
@@ -495,6 +596,11 @@ class FedAvgClientManager(NodeManager):
             time.sleep(self.train_delay)
         variables = tree_from_wire(msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.template)
         client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
+        if client_idx is None:
+            # multicast sync: ONE shared envelope for the whole cohort —
+            # identity is derived from the node id (node = client + 1),
+            # exactly what the per-node unicast params always carried
+            client_idx = self.backend.node_id - 1
         round_idx = msg.get(MSG_ARG_KEY_ROUND_INDEX)
         # round-independent pack seed, matching FedAvgSimulation's
         # device-resident cohort blocks: the pack base order carries no

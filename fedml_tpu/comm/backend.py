@@ -53,6 +53,20 @@ class CommBackend(abc.ABC):
     def send_message(self, msg: Message) -> None:
         ...
 
+    def send_multicast(self, msg: Message, receivers) -> None:
+        """Fan ONE message out to many receivers.
+
+        Base implementation: per-receiver shallow clones through
+        ``send_message`` (payload objects shared, so nothing is
+        re-encoded).  Transports with a native fan-out primitive (the
+        TCP hub's ``__hub__: mcast`` frame) override this to ship the
+        payload once; the chaos wrapper overrides it to apply fault
+        rules per receiver — a dropped copy is one node's, not the
+        whole broadcast's.
+        """
+        for r in receivers:
+            self.send_message(msg.clone_for(int(r)))
+
     @abc.abstractmethod
     def run(self) -> None:
         """Deliver incoming messages to observers until stopped."""
@@ -130,6 +144,9 @@ class NodeManager(Observer):
 
     def send_message(self, msg: Message) -> None:
         self.backend.send_message(msg)
+
+    def send_multicast(self, msg: Message, receivers) -> None:
+        self.backend.send_multicast(msg, receivers)
 
     def run(self) -> None:
         self.backend.run()

@@ -63,10 +63,15 @@ class Message:
             MSG_ARG_KEY_SENDER: sender,
             MSG_ARG_KEY_RECEIVER: receiver,
         }
+        # memoized to_frame_parts() encoding: broadcast fan-out and send
+        # retries reuse ONE immutable buffer list instead of re-encoding
+        # a multi-MB frame per receiver/attempt
+        self._frame_parts = None
 
     # -- reference API surface --
     def add_params(self, key: str, value: Any) -> "Message":
         self.params[key] = value
+        self._frame_parts = None  # invalidate any cached wire encoding
         return self
 
     add = add_params
@@ -100,16 +105,44 @@ class Message:
         bytes follow the newline.  Messages without arrays serialize
         to a plain JSON line — readable and v1-identical.
         """
-        bufs: List[bytes] = []
+        return b"".join(self.to_frame_parts())
+
+    def to_frame_parts(self) -> List:
+        """Zero-copy form of ``to_frame``: a list of buffers (header
+        line first, then raw array memoryviews) whose concatenation IS
+        the frame.  Nothing multi-MB is copied: array leaves stay
+        memoryviews over their backing storage, and transports write
+        them with a vectored ``sendmsg`` instead of joining.
+
+        The list is memoized on the instance (``add_params``
+        invalidates), so broadcast fan-out, unicast fallback, and send
+        retries all reuse ONE immutable encoding — the per-round sync
+        frame is serialized exactly once however many nodes it reaches.
+        """
+        parts = self._frame_parts
+        if parts is not None:
+            return parts
+        bufs: List = []
         header = _extract_buffers(self.params, bufs, [0])
         if not bufs:
-            return (self.to_json() + "\n").encode()
-        payload = b"".join(bufs)
-        header[FRAME_BINLEN_KEY] = len(payload)
-        return (
-            json.dumps(header, default=_encode_value).encode()
-            + b"\n" + payload
-        )
+            parts = [(self.to_json() + "\n").encode()]
+        else:
+            header[FRAME_BINLEN_KEY] = sum(len(b) for b in bufs)
+            parts = [
+                json.dumps(header, default=_encode_value).encode() + b"\n",
+                *bufs,
+            ]
+        self._frame_parts = parts
+        return parts
+
+    def clone_for(self, receiver: int) -> "Message":
+        """Shallow per-receiver copy (payload objects shared, nothing
+        re-encoded): how the base multicast fan-out and the chaos
+        layer's per-receiver faults address one node of a broadcast."""
+        m = Message()
+        m.params = dict(self.params)
+        m.params[MSG_ARG_KEY_RECEIVER] = receiver
+        return m
 
     @classmethod
     def from_frame(cls, header_obj: dict, payload: bytes = b"") -> "Message":
@@ -255,12 +288,18 @@ def _is_raw_array(v) -> bool:
     )
 
 
-def _extract_buffers(v, bufs: List[bytes], offset: List[int]):
+def _extract_buffers(v, bufs: List, offset: List[int]):
     """Deep-copy ``v`` with every raw array replaced by an
-    ``__ndbuf__`` reference; the array bytes append to ``bufs``."""
+    ``__ndbuf__`` reference; a zero-copy memoryview of the array bytes
+    appends to ``bufs`` (the view keeps its array alive)."""
     if _is_raw_array(v):
         a = np.ascontiguousarray(np.asarray(v))
-        b = a.tobytes()
+        # reshape(-1) is copy-free on a contiguous array and gives a
+        # 1-D buffer cast("B") accepts even for 0-d leaves
+        try:
+            b = memoryview(a.reshape(-1)).cast("B")
+        except (TypeError, ValueError, BufferError):
+            b = a.tobytes()  # exotic dtypes (ml_dtypes) may refuse cast
         ref = {
             "__ndbuf__": [offset[0], len(b)],
             "dtype": str(a.dtype),

@@ -27,11 +27,13 @@ from __future__ import annotations
 
 import json
 import logging
+import queue
 import random
 import socket
 import threading
 import time
-from typing import Dict
+from collections import deque
+from typing import Dict, List, Tuple
 
 from fedml_tpu.comm.backend import CommBackend
 from fedml_tpu.comm.message import FRAME_BINLEN_KEY, Message
@@ -40,11 +42,94 @@ from fedml_tpu.obs.telemetry import get_telemetry
 _SENTINEL = {"__hub__": "stop"}
 _ACK = {"__hub__": "ack"}
 
+# Per-socket buffer target: model frames are multi-MB, and the Linux
+# defaults (~208 KiB) force many small send/recv cycles per frame even
+# on loopback.  The kernel clamps to net.core.{r,w}mem_max — tuning is
+# best-effort by design.
+_TCP_SOCK_BUF = 4 << 20
+
+
+def _tune_socket(sock: socket.socket) -> None:
+    """TCP_NODELAY + sized buffers on every hub/backend socket: frames
+    are written whole (vectored), so Nagle batching only adds latency,
+    and sized buffers keep a multi-MB frame moving in few syscalls."""
+    try:
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_SNDBUF, _TCP_SOCK_BUF)
+        sock.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, _TCP_SOCK_BUF)
+    except OSError:
+        pass  # exotic stacks/containers may refuse; correctness unaffected
+
+
+def _iov_max() -> int:
+    try:
+        import os
+
+        return os.sysconf("SC_IOV_MAX")
+    except (OSError, ValueError, AttributeError):
+        return 1024  # POSIX minimum is 16; Linux ships 1024
+
+
+_IOV_MAX = _iov_max()
+
+
+def _sendall_parts(sock: socket.socket, parts) -> None:
+    """Vectored ``sendall`` of one complete frame given as a buffer
+    list: multi-MB payloads are never concatenated into a fresh
+    bytestring (``Message.to_frame_parts`` zero-copy contract).
+    Partial ``sendmsg`` returns advance memoryviews until done; each
+    call is capped at IOV_MAX buffers (a deep pytree emits one buffer
+    per leaf — past the cap sendmsg would fail with EMSGSIZE)."""
+    pending = [p if isinstance(p, memoryview) else memoryview(p)
+               for p in parts]
+    pending = [v if v.format == "B" and v.ndim == 1 else v.cast("B")
+               for v in pending]
+    if not hasattr(sock, "sendmsg"):  # non-POSIX fallback
+        sock.sendall(b"".join(pending))
+        return
+    while pending:
+        sent = sock.sendmsg(pending[:_IOV_MAX])
+        while sent:
+            head = pending[0]
+            if sent >= len(head):
+                sent -= len(head)
+                pending.pop(0)
+            else:
+                pending[0] = head[sent:]
+                sent = 0
+
+
+class _Conn:
+    """One registered node: socket + bounded outbound frame queue.
+
+    ``scheduled`` enforces a single drainer at a time (a connection is
+    only ever serviced by the one sender worker it was handed to), so
+    per-connection order is FIFO and frames can never interleave
+    mid-payload — the invariant the old per-conn send locks provided,
+    now without serializing the fan-out behind the router thread."""
+
+    __slots__ = ("sock", "frames", "nbytes", "scheduled")
+
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.frames: deque = deque()  # (msg_type, parts) entries
+        self.nbytes = 0
+        self.scheduled = False
+
 
 class TcpHub:
-    """Central router: node_id → connection. Start once per federation."""
+    """Central router: node_id → connection. Start once per federation.
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+    Outbound frames ride per-connection bounded queues drained by a
+    small sender pool — a reader thread only ever ENQUEUES (O(1)), so
+    one slow receiver no longer stalls routing for everyone else, and a
+    ``__hub__: mcast`` frame (one payload + a receiver list) fans out
+    by enqueueing the SAME immutable bytes to every receiver: the
+    server→hub broadcast leg carries each sync exactly once."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 senders: int = 4, max_queue_bytes: int = 256 << 20,
+                 max_queue_frames: int = 4096):
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()
         # frames to unregistered/dead receivers are dropped BY DESIGN
@@ -52,13 +137,24 @@ class TcpHub:
         # invisibly so until now: count them per message type so chaos
         # runs can reconcile observed drops against injected ones
         self.dropped_frames: Dict[str, int] = {}
-        self._conns: Dict[int, socket.socket] = {}
-        # per-connection send locks: sendall on a multi-MB frame loops
-        # over partial sends, so two reader threads forwarding to the
-        # same receiver concurrently would interleave mid-payload
-        self._send_locks: Dict[int, threading.Lock] = {}
+        # backpressure: a receiver whose queue exceeds the bound loses
+        # the NEW frame (counted in dropped_frames too) — bounded memory
+        # beats an unbounded queue wedging the hub behind a dead-slow peer
+        self.backpressure_drops = 0
+        self.mcast_frames = 0
+        self.mcast_copies = 0
+        self._max_queue_bytes = max_queue_bytes
+        self._max_queue_frames = max_queue_frames
+        self._conns: Dict[int, _Conn] = {}
         self._lock = threading.Lock()
+        self._ready: "queue.SimpleQueue" = queue.SimpleQueue()
         self._running = True
+        self._senders = [
+            threading.Thread(target=self._sender_loop, daemon=True)
+            for _ in range(max(1, int(senders)))
+        ]
+        for t in self._senders:
+            t.start()
         self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
         self._accept_thread.start()
 
@@ -74,24 +170,26 @@ class TcpHub:
 
     def _serve_conn(self, conn: socket.socket):
         node_id = None
+        st = None
         try:
+            _tune_socket(conn)
             f = conn.makefile("rb")
             hello = f.readline()
             if not hello:
                 return
             node_id = json.loads(hello)["node_id"]
-            # ACK BEFORE registering: once registered, _forward from
-            # other reader threads may write to this conn concurrently,
-            # and an ACK interleaved with a routed frame would hand the
-            # dialing client garbage as its handshake line.  A frame
-            # routed in the ack→register window is dropped — but nobody
-            # can have observed this node as registered yet (await_peers
-            # reads the registry), so that is the normal unregistered-
+            # ACK BEFORE registering: once registered, the sender pool
+            # may write to this conn concurrently, and an ACK
+            # interleaved with a routed frame would hand the dialing
+            # client garbage as its handshake line.  A frame routed in
+            # the ack→register window is dropped — but nobody can have
+            # observed this node as registered yet (await_peers reads
+            # the registry), so that is the normal unregistered-
             # receiver drop, not a race.
             conn.sendall((json.dumps(_ACK) + "\n").encode())
+            st = _Conn(conn)
             with self._lock:
-                self._conns[node_id] = conn
-                self._send_locks[node_id] = threading.Lock()
+                self._conns[node_id] = st
             while True:
                 line = f.readline()
                 if not line:
@@ -119,6 +217,25 @@ class TcpHub:
                     payload = f.read(binlen)
                     if len(payload) < binlen:
                         break  # peer died mid-payload: torn frame == EOF
+                if frame.get("__hub__") == "mcast":
+                    # hub multicast: ``payload`` is ONE complete inner
+                    # frame (header line + buffers) shipped once over
+                    # the server→hub leg; fan it out by enqueueing the
+                    # SAME immutable bytes per receiver — receivers see
+                    # an ordinary frame, no client-side support needed
+                    receivers = frame.get("receivers") or []
+                    mt = frame.get("msg_type")
+                    if not payload:
+                        logging.warning("hub: mcast frame without payload")
+                        continue
+                    with self._lock:
+                        self.mcast_frames += 1
+                        self.mcast_copies += len(receivers)
+                    get_telemetry().inc("hub.mcast_frames",
+                                        msg_type=mt or "?")
+                    for r in receivers:
+                        self._forward(r, (payload,), msg_type=mt)
+                    continue
                 if frame.get("__hub__") == "peers":
                     # membership introspection: reply to THIS node with
                     # the currently registered ids (startup barrier —
@@ -128,52 +245,105 @@ class TcpHub:
                         ids = sorted(self._conns)
                     self._forward(
                         node_id,
-                        (json.dumps({"__hub__": "peers", "ids": ids}) + "\n").encode(),
+                        ((json.dumps({"__hub__": "peers", "ids": ids})
+                          + "\n").encode(),),
                     )
                     continue
                 if frame.get("__hub__") == "stop":
                     break
                 receiver = frame.get("receiver")
                 if receiver is not None:
-                    self._forward(receiver, line + payload,
+                    self._forward(receiver,
+                                  (line, payload) if payload else (line,),
                                   msg_type=frame.get("msg_type"))
         except OSError:
             pass  # peer vanished: fall through to cleanup
         finally:
-            if node_id is not None:
+            if node_id is not None and st is not None:
                 with self._lock:
                     # identity guard: a re-registered node may have
                     # replaced this conn; don't deregister the live one
-                    if self._conns.get(node_id) is conn:
+                    if self._conns.get(node_id) is st:
                         self._conns.pop(node_id, None)
-                        self._send_locks.pop(node_id, None)
             try:
                 conn.close()
             except OSError:
                 pass
 
-    def _forward(self, receiver: int, raw_line: bytes, msg_type=None):
+    def _forward(self, receiver: int, parts: Tuple, msg_type=None):
+        """Enqueue one COMPLETE frame (header line [+ payload]) for
+        ``receiver``; the sender pool writes it.  Unknown receivers and
+        over-bound queues drop the frame — counted, by design (the
+        round deadline treats the receiver as a straggler)."""
+        nbytes = sum(len(p) for p in parts)
+        wake = False
+        dropped = False
         with self._lock:
-            conn = self._conns.get(receiver)
-            send_lock = self._send_locks.get(receiver)
-        if conn is None or send_lock is None:
+            st = self._conns.get(receiver)
+            if st is None:
+                dropped = True
+            elif (len(st.frames) >= self._max_queue_frames
+                    or st.nbytes + nbytes > self._max_queue_bytes):
+                self.backpressure_drops += 1
+                dropped = True
+            else:
+                st.frames.append((msg_type, parts))
+                st.nbytes += nbytes
+                if not st.scheduled:
+                    st.scheduled = True
+                    wake = True
+        if dropped:
             self._count_drop(receiver, msg_type)
             return
-        try:
-            with send_lock:
-                # raw_line is a COMPLETE frame: a header line read by
-                # readline (always \n-terminated) plus, for v2, exactly
-                # __binlen__ payload bytes — appending anything to a
-                # binary frame would desync the receiver's payload read
-                conn.sendall(raw_line)
-        except OSError:
-            # dead receiver: unregister so later sends don't retry it;
-            # its own reader thread finishes cleanup
-            self._count_drop(receiver, msg_type)
-            with self._lock:
-                if self._conns.get(receiver) is conn:
-                    self._conns.pop(receiver, None)
-                    self._send_locks.pop(receiver, None)
+        if wake:
+            self._ready.put((receiver, st))
+
+    def _sender_loop(self):
+        """Sender-pool worker: drain the one connection handed to it.
+        A worker only ever services the exact ``_Conn`` it was
+        scheduled for (never a same-id replacement), so a reconnecting
+        node can't end up with two drainers interleaving its stream."""
+        while True:
+            item = self._ready.get()
+            if item is None:
+                return
+            nid, st = item
+            while True:
+                with self._lock:
+                    if self._conns.get(nid) is not st:
+                        break  # replaced/deregistered: frames die with it
+                    if not st.frames:
+                        st.scheduled = False
+                        break
+                    msg_type, parts = st.frames.popleft()
+                    st.nbytes -= sum(len(p) for p in parts)
+                try:
+                    _sendall_parts(st.sock, parts)
+                except OSError:
+                    # dead receiver: count this frame + everything still
+                    # queued, deregister (its reader thread finishes
+                    # cleanup when it sees EOF)
+                    self._count_drop(nid, msg_type)
+                    with self._lock:
+                        if self._conns.get(nid) is st:
+                            self._conns.pop(nid, None)
+                        leftovers = [mt for mt, _ in st.frames]
+                        st.frames.clear()
+                        st.nbytes = 0
+                    for mt in leftovers:
+                        self._count_drop(nid, mt)
+                    break
+                except Exception:
+                    # never lose a pool worker to an unexpected bug —
+                    # the hub would silently shrink its send capacity.
+                    # Count the frame lost and KEEP DRAINING: breaking
+                    # here would leave st.scheduled=True with frames
+                    # queued and no drainer — a permanently wedged
+                    # receiver (worse than the bug being survived)
+                    logging.exception("hub: sender worker error for "
+                                      "node %s", nid)
+                    self._count_drop(nid, msg_type)
+                    continue
 
     def _count_drop(self, receiver: int, msg_type) -> None:
         mt = msg_type or "__hub__"
@@ -184,20 +354,27 @@ class TcpHub:
                       mt, receiver)
 
     def stats(self) -> dict:
-        """Hub-side fault accounting (``run_hub`` prints this at
-        shutdown so multi-process chaos drivers can collect it)."""
+        """Hub-side fault + fan-out accounting (``run_hub`` prints this
+        at shutdown so multi-process chaos drivers can collect it)."""
         with self._lock:
-            return {"dropped_frames": dict(self.dropped_frames)}
+            return {
+                "dropped_frames": dict(self.dropped_frames),
+                "backpressure_drops": self.backpressure_drops,
+                "mcast_frames": self.mcast_frames,
+                "mcast_copies": self.mcast_copies,
+            }
 
     def stop(self):
         self._running = False
         with self._lock:
-            conns = list(self._conns.values())
-        for c in conns:
+            states = list(self._conns.values())
+        for st in states:
             try:
-                c.close()
+                st.sock.close()
             except OSError:
                 pass
+        for _ in self._senders:
+            self._ready.put(None)
         self._srv.close()
 
 
@@ -242,6 +419,7 @@ class TcpBackend(CommBackend):
             sock = socket.create_connection(
                 (self._host, self._port), timeout=self._timeout
             )
+            _tune_socket(sock)
             try:
                 sock.sendall(
                     (json.dumps({"node_id": self.node_id}) + "\n").encode()
@@ -275,42 +453,84 @@ class TcpBackend(CommBackend):
                         pass
             self._sock, self._file = sock, f
 
-    def send_message(self, msg: Message) -> None:
-        # v2: header line + raw buffers (to_frame); v1: one JSON line
-        # (newlines escape inside JSON strings) — either way ONE bytes
-        # object, sent atomically under the send lock
-        t0 = time.perf_counter()
-        if self.wire >= 2:
-            data = msg.to_frame()
-        else:
-            data = (msg.to_json() + "\n").encode()
-        # Bounded retry with exponential backoff + jitter: each attempt
-        # re-reads self._sock, so a reconnect (reader thread's _dial
-        # swapping the socket) between attempts is picked up.  A retry
-        # after a PARTIAL sendall hands the hub a garbled header line —
-        # the hub treats that as fatal for the CONNECTION (frames may
-        # carry binary payloads, so a garbled boundary cannot be
-        # resynchronized) and drops it; this node's reader then sees
-        # EOF and the auto_reconnect/round-deadline machinery covers
-        # the lost frame.  Never stream corruption, at worst one
-        # reconnect.  A backend killed by _kill_connection must not
-        # retry: the stream is desync-fatal by contract and callers
-        # expect OSError.
+    def _send_parts(self, parts: List, msg_type: str) -> None:
+        """Bounded-retry vectored write of one complete frame.
+
+        Exponential backoff + jitter: each attempt re-reads self._sock,
+        so a reconnect (reader thread's _dial swapping the socket)
+        between attempts is picked up.  A retry after a PARTIAL write
+        hands the hub a garbled header line — the hub treats that as
+        fatal for the CONNECTION (frames may carry binary payloads, so
+        a garbled boundary cannot be resynchronized) and drops it; this
+        node's reader then sees EOF and the auto_reconnect/round-
+        deadline machinery covers the lost frame.  Never stream
+        corruption, at worst one reconnect.  A backend killed by
+        _kill_connection must not retry: the stream is desync-fatal by
+        contract and callers expect OSError.  ``parts`` is immutable
+        across attempts (and across broadcast receivers) — the frame is
+        encoded exactly once however many times it is written.
+        """
         delay = 0.05
         for attempt in range(self.send_retries + 1):
             try:
                 with self._send_lock:
-                    self._sock.sendall(data)
-                break
+                    _sendall_parts(self._sock, parts)
+                return
             except OSError:
                 if self._stopped.is_set() or attempt >= self.send_retries:
                     raise
-                get_telemetry().inc("comm.send_retries", msg_type=msg.type)
+                get_telemetry().inc("comm.send_retries", msg_type=msg_type)
                 time.sleep(delay * (1.0 + random.random()))
                 delay = min(delay * 2.0, 2.0)
+
+    def send_message(self, msg: Message) -> None:
+        # v2: header line + raw buffer views (to_frame_parts, memoized
+        # on the message); v1: one JSON line (newlines escape inside
+        # JSON strings) — either way ONE complete frame, written
+        # atomically (vectored) under the send lock
+        t0 = time.perf_counter()
+        if self.wire >= 2:
+            parts = msg.to_frame_parts()
+        else:
+            parts = [(msg.to_json() + "\n").encode()]
+        self._send_parts(parts, msg.type)
         # exact wire bytes; latency covers serialize + socket write
         # (including any backoff — a retried send IS that slow)
-        self._record_send(msg, len(data), time.perf_counter() - t0)
+        self._record_send(msg, sum(len(p) for p in parts),
+                          time.perf_counter() - t0)
+
+    def send_multicast(self, msg: Message, receivers) -> None:
+        """Native hub fan-out: ONE ``__hub__: mcast`` control frame
+        wrapping the encoded message (header line + raw buffers) plus a
+        receiver list.  The hub enqueues the same immutable payload to
+        every receiver, so the server→hub broadcast leg is O(model) per
+        round instead of O(receivers · model) — the counter delta
+        ``tools/federation_latency_run.py`` measures."""
+        receivers = [int(r) for r in receivers]
+        if not receivers:
+            return
+        if self.wire < 2:
+            # v1 frames are legacy JSON lines with per-receiver
+            # envelopes — keep the unicast loop (fallback matrix arm)
+            super().send_multicast(msg, receivers)
+            return
+        t0 = time.perf_counter()
+        inner = msg.to_frame_parts()  # encode ONCE for the whole cohort
+        head = (json.dumps({
+            "__hub__": "mcast",
+            "receivers": receivers,
+            "msg_type": msg.type,
+            FRAME_BINLEN_KEY: sum(len(p) for p in inner),
+        }) + "\n").encode()
+        parts = [head, *inner]
+        self._send_parts(parts, msg.type)
+        t = get_telemetry()
+        t.inc("comm.mcast_sends", msg_type=msg.type)
+        t.inc("comm.mcast_receivers", len(receivers), msg_type=msg.type)
+        # ONE wire frame however many receivers — comm.sent_bytes for a
+        # broadcast now counts the payload once
+        self._record_send(msg, sum(len(p) for p in parts),
+                          time.perf_counter() - t0)
 
     def drop_connection(self) -> None:
         """Fault injection: sever the hub connection WITHOUT stopping

@@ -40,6 +40,49 @@ def tree_weighted_sum(trees: Sequence[PyTree], weights) -> PyTree:
     return acc
 
 
+def tree_fold_weighted(acc: PyTree, tree: PyTree, w) -> PyTree:
+    """One step of a streaming weighted sum: ``acc + w * tree`` per
+    leaf, accumulated host-side in float64 (``acc=None`` starts a new
+    accumulator).  This is the cross-device server's O(model)-memory
+    aggregation primitive: uploads fold in as they ARRIVE instead of
+    being buffered until the round closes.  Numpy (not jnp) on purpose:
+    the fold runs under the server's round lock on the backend reader
+    thread, and a host memcpy-bound add must not pay a device dispatch."""
+    import numpy as np
+
+    w64 = np.float64(w)
+    if acc is None:
+        return jax.tree_util.tree_map(
+            lambda x: w64 * np.asarray(x, np.float64), tree
+        )
+    return jax.tree_util.tree_map(
+        lambda a, x: a + w64 * np.asarray(x, np.float64), acc, tree
+    )
+
+
+def tree_finalize_weighted_mean(acc: PyTree, total, like: PyTree) -> PyTree:
+    """Close a ``tree_fold_weighted`` accumulator: ``acc / total`` cast
+    back to each leaf dtype of ``like`` (the model template)."""
+    import numpy as np
+
+    t64 = np.float64(total)
+    return jax.tree_util.tree_map(
+        lambda a, l: (a / t64).astype(np.asarray(l).dtype), acc, like
+    )
+
+
+def tree_weighted_mean(trees: Sequence[PyTree], weights) -> PyTree:
+    """Buffered reference for the streaming pair above: fold every tree
+    with its RAW weight, then normalize by ``sum(weights)``.  Same ops
+    in the same order as the per-arrival fold, so a streaming server is
+    bit-identical to this — the leaf-exactness pin in tests/test_comm."""
+    acc = None
+    for t, w in zip(trees, weights):
+        acc = tree_fold_weighted(acc, t, w)
+    return tree_finalize_weighted_mean(acc, sum(float(w) for w in weights),
+                                       trees[0])
+
+
 def tree_vdot(a: PyTree, b: PyTree) -> jax.Array:
     leaves = jax.tree_util.tree_map(
         lambda x, y: jnp.vdot(x.astype(jnp.float32), y.astype(jnp.float32)), a, b

@@ -45,11 +45,13 @@ def _force_cpu_if_requested():
         jax.config.update("jax_platforms", "cpu")
 
 
-def _build_problem(seed: int, num_clients: int, input_dim: int = 8):
+def _build_problem(seed: int, num_clients: int, input_dim: int = 8,
+                   train_samples: int = 60):
     """``input_dim`` scales the model (logistic_regression(input_dim, 2))
     so byte-accounting runs can measure compression on a payload large
     enough that the frame envelope is noise (the default 18-param model
-    is all envelope)."""
+    is all envelope); ``train_samples`` (per client) scales local
+    compute, so latency runs can pick a comm-dominant regime."""
     import jax
 
     from fedml_tpu.core.client import make_client_optimizer, make_local_update
@@ -57,7 +59,8 @@ def _build_problem(seed: int, num_clients: int, input_dim: int = 8):
     from fedml_tpu.models.linear import logistic_regression
 
     ds = synthetic_classification(
-        num_train=60 * num_clients, num_test=30, input_shape=(input_dim,),
+        num_train=train_samples * num_clients, num_test=30,
+        input_shape=(input_dim,),
         num_classes=2, num_clients=num_clients, partition="homo", seed=seed,
     )
     bundle = logistic_regression(input_dim, 2)
@@ -156,7 +159,7 @@ def run_server(args) -> None:
     from fedml_tpu.algorithms.fedavg_cross_device import FedAvgServerManager
 
     ds, bundle, init, lu = _build_problem(args.seed, args.num_clients,
-                                          args.input_dim)
+                                          args.input_dim, args.train_samples)
     backend = _maybe_chaos(
         _connect_backend(0, args.host, args.port,
                          auto_reconnect=max(args.auto_reconnect, 0),
@@ -188,6 +191,8 @@ def run_server(args) -> None:
         round_timeout=args.round_timeout or None,
         spares=args.spares,
         codec=args.codec,
+        multicast=args.hotpath == "fast",
+        streaming_agg=args.hotpath == "fast",
     )
     # startup barrier: the hub drops frames to unregistered receivers,
     # so broadcasting before every client registered would hang
@@ -245,7 +250,7 @@ def run_client(args) -> None:
     from fedml_tpu.algorithms.fedavg_cross_device import FedAvgClientManager
 
     ds, bundle, init, lu = _build_problem(args.seed, args.num_clients,
-                                          args.input_dim)
+                                          args.input_dim, args.train_samples)
     # clients ride out transient hub-connection drops: re-dial +
     # re-register, rejoining as a straggler for the missed round (the
     # server's round deadline covers the gap)
@@ -297,6 +302,8 @@ def launch(
     codec: str = "none",
     wire: int = 2,
     input_dim: int = 8,
+    hotpath: str = "fast",
+    train_samples: int = 60,
     info=None,
     env=None,
     server_env=None,
@@ -362,6 +369,10 @@ def launch(
             common += ["--wire", str(wire)]
         if input_dim != 8:
             common += ["--input-dim", str(input_dim)]
+        if hotpath != "fast":
+            common += ["--hotpath", hotpath]
+        if train_samples != 60:
+            common += ["--train-samples", str(train_samples)]
         if round_timeout:
             common += ["--round-timeout", str(round_timeout)]
         if clients_per_round:
@@ -522,6 +533,14 @@ def main(argv=None):
     p.add_argument("--codec", default="none")
     p.add_argument("--wire", type=int, choices=[1, 2], default=2)
     p.add_argument("--input-dim", type=int, default=8)
+    # wire hot-path knobs: --hotpath legacy reverts the server to
+    # per-node unicast broadcast + buffered close-time aggregation (the
+    # pre-multicast behavior — the latency measurement's baseline arm
+    # and the interop mode for peers that can't derive identity from
+    # their node id); --train-samples scales per-client local compute
+    # so latency runs can pick a comm-dominant regime
+    p.add_argument("--hotpath", choices=["fast", "legacy"], default="fast")
+    p.add_argument("--train-samples", type=int, default=60)
     args = p.parse_args(argv)
     if args.role == "hub":
         run_hub(args.host, args.port)

@@ -155,7 +155,7 @@ class ChaosBackend(CommBackend):
         self.telemetry.inc("faults.injected", action=action, msg_type=msg_type)
 
     def _apply(self, direction: str, msg: Message,
-               forward: Callable[[Message], None]) -> None:
+               forward: Callable[[Message], None], receiver=None) -> None:
         msg_type = msg.type
         if not self.plan.applies_to(msg_type):
             forward(msg)
@@ -163,7 +163,8 @@ class ChaosBackend(CommBackend):
             return
         seq = self._next_seq(direction, msg_type)
         acts = self.plan.decide(
-            self.node_id, direction, msg_type, seq, msg.get("round_idx")
+            self.node_id, direction, msg_type, seq, msg.get("round_idx"),
+            receiver=receiver,
         )
         self.trace.append(
             (direction, msg_type, seq,
@@ -173,6 +174,15 @@ class ChaosBackend(CommBackend):
             self._inject("drop", msg_type)
             self._tick(direction)
             return
+        self._route(direction, msg, forward, acts, seq)
+
+    def _route(self, direction: str, msg: Message,
+               forward: Callable[[Message], None], acts, seq: int) -> None:
+        """Execute an already-decided non-drop action list on one
+        message (the post-decision half of ``_apply``, shared with the
+        per-receiver multicast path).  ``seq`` seeds the corrupt rng —
+        the same per-message stream the decision drew from."""
+        msg_type = msg.type
         disconnect = False
         delay = None
         new_hold = None
@@ -251,7 +261,51 @@ class ChaosBackend(CommBackend):
 
     # -- CommBackend surface ------------------------------------------------
     def send_message(self, msg: Message) -> None:
-        self._apply("send", msg, self.inner.send_message)
+        self._apply("send", msg, self.inner.send_message,
+                    receiver=msg.receiver)
+
+    def send_multicast(self, msg: Message, receivers) -> None:
+        """Per-receiver fault application on a broadcast: the plan is
+        consulted once per receiver (its own sequence number, exactly
+        the stream the K-unicast loop would have drawn), so a drop rule
+        for node 3 drops ONLY node 3's copy.  Clean receivers still
+        ride the inner transport's native fan-out in one frame; faulted
+        copies peel off onto the unicast path as per-receiver clones
+        (shared payload objects — nothing re-encoded)."""
+        receivers = [int(r) for r in receivers]
+        if not receivers:
+            return
+        if not self.plan.applies_to(msg.type):
+            self.inner.send_multicast(msg, receivers)
+            # one tick PER RECEIVER, exactly like the K-unicast loop
+            # this replaced — held-message aging must not depend on
+            # whether the plan happens to cover this broadcast's type
+            for _ in receivers:
+                self._tick("send")
+            return
+        clean = []
+        for r in receivers:
+            seq = self._next_seq("send", msg.type)
+            acts = self.plan.decide(
+                self.node_id, "send", msg.type, seq, msg.get("round_idx"),
+                receiver=r,
+            )
+            self.trace.append(
+                ("send", msg.type, seq,
+                 tuple(a["action"] for a in acts) or ("deliver",))
+            )
+            if any(a["action"] == "drop" for a in acts):
+                self._inject("drop", msg.type)
+                self._tick("send")
+                continue
+            if not acts:
+                clean.append(r)
+                self._tick("send")
+                continue
+            self._route("send", msg.clone_for(r),
+                        self.inner.send_message, acts, seq)
+        if clean:
+            self.inner.send_multicast(msg, clean)
 
     def _deliver(self, msg: Message) -> None:
         # inner._notify already recorded comm.recv for this frame —

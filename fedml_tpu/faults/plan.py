@@ -84,13 +84,17 @@ class FaultRule:
     """One scheduled fault: fires on every message matching ALL set
     fields (``None`` = wildcard).  ``round`` matches the message's
     ``round_idx`` param, so "drop client 2's upload in round 1" is
-    expressible exactly."""
+    expressible exactly.  ``receiver`` matches the message's receiver
+    id — on a MULTICAST fan-out the plan is consulted once per
+    receiver, so "drop node 3's copy of the sync" drops exactly that
+    copy and nobody else's (``ChaosBackend.send_multicast``)."""
 
     action: str
     node: Optional[int] = None
     msg_type: Optional[str] = None
     round: Optional[int] = None
     direction: str = "send"
+    receiver: Optional[int] = None
     delay_msgs: int = 1
     delay_s: float = 0.05
 
@@ -102,12 +106,14 @@ class FaultRule:
         if self.direction not in ("send", "recv"):
             raise ValueError(f"direction must be send|recv: {self.direction!r}")
 
-    def matches(self, node, direction, msg_type, round_idx) -> bool:
+    def matches(self, node, direction, msg_type, round_idx,
+                receiver=None) -> bool:
         return (
             self.direction == direction
             and (self.node is None or self.node == node)
             and (self.msg_type is None or self.msg_type == msg_type)
             and (self.round is None or self.round == round_idx)
+            and (self.receiver is None or self.receiver == receiver)
         )
 
 
@@ -171,13 +177,16 @@ class FaultPlan:
         )
 
     def decide(self, node: int, direction: str, msg_type: str, seq: int,
-               round_idx: Optional[int] = None) -> list:
+               round_idx: Optional[int] = None,
+               receiver: Optional[int] = None) -> list:
         """Actions for the ``seq``-th ``msg_type`` message this node
-        moves in ``direction``.  Returns a list of action dicts,
-        possibly empty (= deliver untouched)."""
+        moves in ``direction`` (``receiver`` scopes receiver-filtered
+        rules; a multicast consults the plan once per receiver).
+        Returns a list of action dicts, possibly empty (= deliver
+        untouched)."""
         acts = []
         for rule in self.rules:
-            if rule.matches(node, direction, msg_type, round_idx):
+            if rule.matches(node, direction, msg_type, round_idx, receiver):
                 acts.append({
                     "action": rule.action,
                     "delay_msgs": rule.delay_msgs,

@@ -149,6 +149,341 @@ def test_cross_device_fedavg_tcp():
                for l in jax.tree_util.tree_leaves(server.variables))
 
 
+def test_message_frame_parts_cached_and_join_equals_frame():
+    """to_frame_parts is the zero-copy encoding: its concatenation IS
+    to_frame(), it is memoized on the message (broadcast fan-out and
+    retries reuse ONE immutable buffer list), and add_params
+    invalidates the memo."""
+    m = Message(MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+    m.add_params("weights", np.arange(12, dtype=np.float32).reshape(3, 4))
+    parts = m.to_frame_parts()
+    assert b"".join(parts) == m.to_frame()
+    assert m.to_frame_parts() is parts  # memoized
+    m.add_params("extra", 1)
+    parts2 = m.to_frame_parts()
+    assert parts2 is not parts  # invalidated by the param change
+    # a message without arrays is a single v1-identical JSON line
+    plain = Message("X", 1, 0)
+    assert plain.to_frame_parts() == [(plain.to_json() + "\n").encode()]
+
+
+def test_hub_multicast_fans_out_one_payload():
+    """One ``__hub__: mcast`` frame reaches every receiver byte-
+    identical, while the sender's wire accounting shows the payload was
+    shipped to the hub exactly ONCE (the O(model)-per-round broadcast
+    contract)."""
+    import time
+
+    from fedml_tpu.obs.telemetry import get_telemetry
+
+    hub = TcpHub()
+    got = {1: [], 2: [], 3: []}
+
+    class Obs:
+        def __init__(self, i):
+            self.i = i
+
+        def receive_message(self, t, m):
+            got[self.i].append(m)
+
+    receivers = []
+    for i in (1, 2, 3):
+        b = TcpBackend(i, hub.host, hub.port)
+        b.add_observer(Obs(i))
+        b.run_in_thread()
+        receivers.append(b)
+    sender = TcpBackend(9, hub.host, hub.port)
+    sender.await_peers([1, 2, 3])
+    payload = np.arange(300_000, dtype=np.float32)  # 1.2 MB
+    m = Message("MCAST_PIN", 9, -1)
+    m.add_params("model", payload)
+    before = get_telemetry().snapshot()["counters"]
+    sender.send_multicast(m, [1, 2, 3])
+    deadline = time.monotonic() + 15
+    while any(not got[i] for i in (1, 2, 3)) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    for i in (1, 2, 3):
+        assert got[i], f"node {i} never received the multicast"
+        back = got[i][0]
+        assert back.sender == 9
+        np.testing.assert_array_equal(np.asarray(back.get("model")), payload)
+    after = get_telemetry().snapshot()["counters"]
+    key = "comm.sent_bytes{msg_type=MCAST_PIN}"
+    sent = after.get(key, 0) - before.get(key, 0)
+    # one payload + headers — NOT three copies
+    assert payload.nbytes <= sent < 2 * payload.nbytes
+    stats = hub.stats()
+    assert stats["mcast_frames"] == 1 and stats["mcast_copies"] == 3
+    for b in receivers:
+        b.stop()
+    sender.stop()
+    hub.stop()
+
+
+def test_deep_pytree_frame_exceeding_iov_max_roundtrips():
+    """A frame with more buffers than IOV_MAX (one per array leaf) must
+    still send — _sendall_parts chunks the vectored write instead of
+    letting sendmsg fail with EMSGSIZE."""
+    import time
+
+    from fedml_tpu.comm.tcp import _IOV_MAX
+
+    n_leaves = _IOV_MAX + 200
+    leaves = [np.full((3,), float(i), np.float32) for i in range(n_leaves)]
+    hub = TcpHub()
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+
+    receiver = TcpBackend(1, hub.host, hub.port)
+    receiver.add_observer(Obs())
+    receiver.run_in_thread()
+    sender = TcpBackend(2, hub.host, hub.port)
+    sender.await_peers([1])
+    m = Message("DEEP", 2, 1)
+    m.add_params("leaves", leaves)
+    assert len(m.to_frame_parts()) > _IOV_MAX
+    sender.send_message(m)
+    deadline = time.monotonic() + 15
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert got, "deep-pytree frame never arrived"
+    back = got[0].get("leaves")
+    assert len(back) == n_leaves
+    for i in (0, n_leaves // 2, n_leaves - 1):
+        np.testing.assert_array_equal(np.asarray(back[i]), leaves[i])
+    receiver.stop()
+    sender.stop()
+    hub.stop()
+
+
+def test_multicast_base_fallback_unicast_clones():
+    """Transports without a native fan-out (inproc) deliver per-receiver
+    clones of ONE message: correct receiver ids, shared payload."""
+    bus = InprocBus()
+    sender = bus.register(0)
+    got = {}
+
+    class Obs:
+        def __init__(self, i):
+            self.i = i
+
+        def receive_message(self, t, m):
+            got[self.i] = m
+
+    for i in (1, 2):
+        b = bus.register(i)
+        b.add_observer(Obs(i))
+    m = Message("X", 0, -1)
+    w = np.ones((2, 2), np.float32)
+    m.add_params("w", w)
+    sender.send_multicast(m, [1, 2])
+    bus.drain()
+    assert got[1].receiver == 1 and got[2].receiver == 2
+    assert got[1].get("w") is w and got[2].get("w") is w  # shared, not copied
+
+
+def test_tcp_socket_options_applied():
+    """TCP_NODELAY + sized SO_SNDBUF/SO_RCVBUF on both ends of a hub
+    connection (multi-MB frames must not ride Nagle + default buffers)."""
+    import socket as _socket
+    import time
+
+    hub = TcpHub()
+    b = TcpBackend(1, hub.host, hub.port)
+    deadline = time.monotonic() + 5
+    while 1 not in hub._conns and time.monotonic() < deadline:
+        time.sleep(0.01)
+    for sock in (b._sock, hub._conns[1].sock):
+        assert sock.getsockopt(_socket.IPPROTO_TCP, _socket.TCP_NODELAY) != 0
+        # the kernel clamps SO_*BUF to net.core.*mem_max — assert a
+        # floor well above the pre-tuning default rather than the exact
+        # requested size
+        assert sock.getsockopt(_socket.SOL_SOCKET, _socket.SO_SNDBUF) >= 64 * 1024
+        assert sock.getsockopt(_socket.SOL_SOCKET, _socket.SO_RCVBUF) >= 64 * 1024
+    b.stop()
+    hub.stop()
+
+
+def test_concurrent_send_frame_integrity_under_multicast():
+    """Stress: several threads pushing multi-MB v2 frames through ONE
+    TcpBackend while another backend multicasts to the same receiver —
+    every frame must arrive whole and byte-identical (pins the
+    per-connection queue + single-drainer design; tearing would show up
+    as mixed-tag payloads or undecodable frames)."""
+    import threading as _threading
+    import time
+
+    hub = TcpHub()
+    recv = []
+    recv_lock = _threading.Lock()
+
+    class Obs:
+        def receive_message(self, t, m):
+            with recv_lock:
+                recv.append((m.get("tag"), np.asarray(m.get("data"))))
+
+    receiver = TcpBackend(1, hub.host, hub.port)
+    receiver.add_observer(Obs())
+    receiver.run_in_thread()
+    sender = TcpBackend(2, hub.host, hub.port)
+    mcaster = TcpBackend(3, hub.host, hub.port)
+    sender.await_peers([1])
+    mcaster.await_peers([1])
+
+    nthreads, nframes, size = 4, 3, 400_000  # 1.6 MB per frame
+
+    def blast(tid):
+        for k in range(nframes):
+            tag = tid * 100 + k
+            m = Message("STRESS", 2, 1)
+            m.add_params("tag", tag)
+            m.add_params("data", np.full(size, float(tag), np.float32))
+            sender.send_message(m)
+
+    threads = [_threading.Thread(target=blast, args=(i,)) for i in range(nthreads)]
+    for t in threads:
+        t.start()
+    for j in range(nframes):
+        tag = 1000 + j
+        mm = Message("STRESS", 3, -1)
+        mm.add_params("tag", tag)
+        mm.add_params("data", np.full(size, float(tag), np.float32))
+        mcaster.send_multicast(mm, [1])
+    for t in threads:
+        t.join(timeout=30)
+    want = nthreads * nframes + nframes
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with recv_lock:
+            if len(recv) >= want:
+                break
+        time.sleep(0.05)
+    with recv_lock:
+        frames = list(recv)
+    assert len(frames) == want, f"lost frames: {len(frames)}/{want}"
+    for tag, arr in frames:
+        assert arr.shape == (size,)
+        np.testing.assert_array_equal(arr, np.full(size, float(tag), np.float32))
+    for b in (receiver, sender, mcaster):
+        b.stop()
+    hub.stop()
+
+
+def test_streaming_aggregation_leaf_exact_mixed_cohort():
+    """The streaming fold (sum n·x on arrival, normalize at close) is
+    BIT-identical to the buffered reference ``tree_weighted_mean`` over
+    the accepted cohort — with a corrupt upload rejected, a stale
+    upload discarded, and over-sampled spares left out — and agrees
+    with the legacy ``tree_weighted_sum`` math to float tolerance."""
+    from fedml_tpu.comm.message import (MSG_ARG_KEY_MODEL_PARAMS,
+                                        MSG_ARG_KEY_NUM_SAMPLES,
+                                        MSG_ARG_KEY_ROUND_INDEX)
+    from fedml_tpu.core import tree as treelib
+
+    bus = InprocBus()
+    server_backend = bus.register(0)
+    for i in range(1, 6):
+        bus.register(i)
+    init = {"params": {"w": np.ones((4, 3), np.float32),
+                       "b": np.zeros((3,), np.float32)}}
+    server = FedAvgServerManager(
+        server_backend, init, num_clients=5, clients_per_round=3,
+        comm_rounds=2, seed=0, spares=2,
+    )
+    assert server.streaming_agg  # the default hot path
+
+    def upload(sender, tree, n, round_idx=0):
+        m = Message(MSG_TYPE_C2S_SEND_MODEL, sender, 0)
+        m.add_params(MSG_ARG_KEY_ROUND_INDEX, round_idx)
+        m.add_params(MSG_ARG_KEY_MODEL_PARAMS, tree_to_wire(tree))
+        m.add_params(MSG_ARG_KEY_NUM_SAMPLES, float(n))
+        server._on_model(m)
+
+    rng = np.random.RandomState(7)
+
+    def rand_tree():
+        return {"params": {"w": rng.randn(4, 3).astype(np.float32),
+                           "b": rng.randn(3).astype(np.float32)}}
+
+    # corrupt upload: NaN leaf — rejected BEFORE it can touch the fold
+    bad = {"params": {"w": np.full((4, 3), np.nan, np.float32),
+                      "b": np.zeros((3,), np.float32)}}
+    upload(4, bad, 7.0)
+    assert server.rejected_uploads == 1 and server._agg_acc is None
+    # stale upload (stamped for a round that isn't open): discarded
+    upload(5, rand_tree(), 5.0, round_idx=3)
+    assert server._agg_acc is None and not server.pending
+    # three accepted uploads with uneven weights close the round (K=3);
+    # nodes 4 and 5 end up spared
+    trees, ns = [], [3.0, 5.0, 11.0]
+    for sender, n in zip((1, 2, 3), ns):
+        t = rand_tree()
+        trees.append(t)
+        upload(sender, t, n)
+        if sender == 1:
+            # a duplicate of an already-folded upload (chaos duplicate
+            # fault) must NOT double-count into the running accumulator
+            upload(1, trees[0], ns[0])
+            assert server._agg_n == ns[0]
+    assert server.round_idx == 1  # closed at the K-th report
+    rec = server.round_log[-1]
+    assert rec["participants"] == [1, 2, 3]
+    assert rec.get("spared") == [4, 5]
+    expected = treelib.tree_weighted_mean(trees, ns)
+    for a, b in zip(jax.tree_util.tree_leaves(server.variables),
+                    jax.tree_util.tree_leaves(expected)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # semantics unchanged vs the legacy buffered math
+    total = sum(ns)
+    legacy = treelib.tree_weighted_sum(trees, [n / total for n in ns])
+    for a, b in zip(jax.tree_util.tree_leaves(server.variables),
+                    jax.tree_util.tree_leaves(legacy)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    # a spare reporting after the close is stale-rejected, not folded
+    upload(4, rand_tree(), 2.0, round_idx=0)
+    assert not server.pending and server._agg_acc is None
+
+
+def test_legacy_hotpath_matches_fast_inproc():
+    """The legacy knobs (per-node unicast + buffered aggregation — the
+    measurement baseline and old-peer interop mode) train to the same
+    model as the default multicast + streaming path."""
+    ds = synthetic_classification(
+        num_train=120, num_test=30, input_shape=(16,), num_classes=4,
+        num_clients=3, partition="homo", seed=3,
+    )
+    bundle = logistic_regression(16, 4)
+    init = bundle.init(jax.random.PRNGKey(3))
+    opt = make_client_optimizer("sgd", 0.1)
+    lu = make_local_update(bundle, opt, 1)
+
+    def run(multicast, streaming):
+        bus = InprocBus()
+        server = FedAvgServerManager(
+            bus.register(0), init, num_clients=3, clients_per_round=3,
+            comm_rounds=3, seed=3, multicast=multicast,
+            streaming_agg=streaming,
+        )
+        for i in range(3):
+            FedAvgClientManager(bus.register(i + 1), lu, ds, batch_size=16,
+                                template_variables=init, seed=3)
+        server.start()
+        bus.drain()
+        assert server.round_idx == 3
+        return server.variables
+
+    fast = run(True, True)
+    legacy = run(False, False)
+    for a, b in zip(jax.tree_util.tree_leaves(fast),
+                    jax.tree_util.tree_leaves(legacy)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
 def test_inproc_bus_unknown_receiver():
     bus = InprocBus()
     bus.register(0)
@@ -296,7 +631,7 @@ def test_tcp_backend_auto_reconnect():
     import socket as _socket
 
     old_conn = hub._conns[5]
-    old_conn.shutdown(_socket.SHUT_RDWR)
+    old_conn.sock.shutdown(_socket.SHUT_RDWR)
     # wait until the hub holds a NEW conn object for node 5 (the stale
     # entry lingers until its reader thread runs cleanup; await_peers
     # alone could observe the dead conn still registered and the test

@@ -168,6 +168,86 @@ def test_chaos_injected_counters_match_trace():
     assert after - before == dropped
 
 
+def _mcast_rig(rule):
+    """Chaos-wrapped sender + three inproc receivers; returns
+    (chaos backend, bus, got dict keyed by node id)."""
+    bus = InprocBus()
+    inner = bus.register(0)
+    got = {1: [], 2: [], 3: []}
+
+    class Obs:
+        def __init__(self, i):
+            self.i = i
+
+        def receive_message(self, t, m):
+            got[self.i].append(m)
+
+    for i in (1, 2, 3):
+        b = bus.register(i)
+        b.add_observer(Obs(i))
+    plan = FaultPlan(seed=0, rules=[rule], roles=("server",))
+    return ChaosBackend(inner, plan), bus, got
+
+
+def test_chaos_multicast_drop_rule_hits_only_its_receiver():
+    """A drop rule scoped to receiver 3 removes ONLY node 3's copy of a
+    multicast fan-out; nodes 1 and 2 still get theirs (the per-receiver
+    plan consultation — fault-equivalent to the K-unicast loop the
+    multicast replaced)."""
+    chaos, bus, got = _mcast_rig(
+        FaultRule(action="drop", receiver=3,
+                  msg_type="S2C_SYNC_MODEL", direction="send")
+    )
+    t = get_telemetry()
+    before = t.counter_value("faults.injected", action="drop",
+                             msg_type="S2C_SYNC_MODEL")
+    m = Message("S2C_SYNC_MODEL", 0, -1)
+    m.add_params(MSG_ARG_KEY_MODEL_PARAMS,
+                 tree_to_wire({"w": np.ones((2, 2), np.float32)}))
+    m.add_params(MSG_ARG_KEY_ROUND_INDEX, 0)
+    chaos.send_multicast(m, [1, 2, 3])
+    bus.drain()
+    assert len(got[1]) == 1 and len(got[2]) == 1
+    assert got[3] == []
+    after = t.counter_value("faults.injected", action="drop",
+                            msg_type="S2C_SYNC_MODEL")
+    assert after - before == 1  # exactly one copy dropped
+    # one plan decision per receiver, consecutive seqs
+    seqs = [seq for (_, mt, seq, _) in chaos.trace if mt == "S2C_SYNC_MODEL"]
+    assert seqs == [0, 1, 2]
+    # the rule round-trips through the env-var JSON shipping path
+    back = FaultPlan.from_json(chaos.plan.to_json())
+    assert back.rules[0].receiver == 3
+    assert [a["action"] for a in
+            back.decide(0, "send", "S2C_SYNC_MODEL", 0, 0, receiver=3)] == ["drop"]
+    assert back.decide(0, "send", "S2C_SYNC_MODEL", 1, 0, receiver=1) == []
+
+
+def test_chaos_multicast_corrupt_rule_hits_only_its_receiver():
+    """A corrupt rule scoped to receiver 2 NaN-fills node 2's copy and
+    ONLY node 2's — the clean receivers ride the shared payload
+    untouched (copy-on-write clone for the faulted node)."""
+    chaos, bus, got = _mcast_rig(
+        FaultRule(action="corrupt", receiver=2,
+                  msg_type="S2C_SYNC_MODEL", direction="send")
+    )
+    m = Message("S2C_SYNC_MODEL", 0, -1)
+    m.add_params(MSG_ARG_KEY_MODEL_PARAMS,
+                 tree_to_wire({"w": np.ones((2, 2), np.float32)}))
+    m.add_params(MSG_ARG_KEY_ROUND_INDEX, 0)
+    chaos.send_multicast(m, [1, 2, 3])
+    bus.drain()
+
+    def finite(msg):
+        wire = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        return all(np.isfinite(np.asarray(l)).all()
+                   for l in wire["leaves"])
+
+    assert len(got[1]) == len(got[2]) == len(got[3]) == 1
+    assert finite(got[1][0]) and finite(got[3][0])
+    assert not finite(got[2][0])
+
+
 def test_reorder_actually_swaps_delivery_order_on_inproc():
     """A delay_msgs=1 hold must deliver AFTER the next message (a true
     swap), not release in place — the same-call tick must not age the
@@ -373,7 +453,12 @@ def test_injected_upload_drop_survives_via_deadline_deterministically():
             return FaultPlan(0, rules=[rule]) if node == 2 else None
 
         bus, server, clients = _inproc_federation(
-            plan_for, num_clients=3, rounds=3, round_timeout=0.6,
+            # 2.0 s deadline: long enough for a cold client jit
+            # under full-suite load (0.6 s flaked there — the round
+            # closed with ZERO participants before anyone trained),
+            # still short enough that the dropped upload, which
+            # NEVER arrives, is what the deadline cuts
+            plan_for, num_clients=3, rounds=3, round_timeout=2.0,
         )
         server.start()
         _drive(bus, server, 3)
@@ -408,7 +493,8 @@ def test_corrupt_upload_rejected_before_aggregation():
     before = t.counter_value("faults.observed", kind="corrupt_upload",
                              msg_type=MSG_TYPE_C2S_SEND_MODEL)
     bus, server, clients = _inproc_federation(
-        plan_for, num_clients=3, rounds=2, round_timeout=0.6,
+        # 2.0 s: same full-suite-load headroom as the drop test above
+        plan_for, num_clients=3, rounds=2, round_timeout=2.0,
     )
     server.start()
     _drive(bus, server, 2)

@@ -62,6 +62,16 @@ def load_records(path: str) -> List[dict]:
     return records
 
 
+def percentile(values: List[float], q: float) -> Optional[float]:
+    """Nearest-rank percentile of raw samples (round wall times are a
+    handful of exact numbers, not histogram buckets)."""
+    if not values:
+        return None
+    vals = sorted(values)
+    idx = min(len(vals) - 1, max(0, int(math.ceil(q * len(vals))) - 1))
+    return vals[idx]
+
+
 def hist_quantile(hist: dict, q: float) -> Optional[float]:
     """Upper-bound estimate of a quantile from the log2 bucket counts."""
     count = hist.get("count", 0)
@@ -156,6 +166,38 @@ def summarize(records: List[dict]) -> dict:
     fault_events = [r for r in records
                     if r.get("kind") in ("degraded_round", "resume")]
 
+    # round latency from the server round_log close stamps ("t"): the
+    # delta between consecutive closes is one round's wall time — the
+    # same numbers FEDLAT artifacts and chaos soaks report, so both
+    # read this one section
+    stamps = [r["t"] for r in rounds
+              if isinstance(r.get("t"), (int, float))]
+    deltas = [b - a for a, b in zip(stamps, stamps[1:])]
+    round_latency = None
+    if deltas:
+        round_latency = {
+            "rounds_timed": len(deltas),
+            "p50_s": percentile(deltas, 0.50),
+            "p95_s": percentile(deltas, 0.95),
+            "max_s": max(deltas),
+            "mean_s": sum(deltas) / len(deltas),
+        }
+    # span.agg_s trend vs realized cohort size: close-time aggregation
+    # cost per participant count (the buffered-vs-streaming stall shows
+    # up here as mean_agg_s growing with K)
+    agg_by_cohort: Dict[int, dict] = {}
+    for r in rounds:
+        if (isinstance(r.get("time_agg"), (int, float))
+                and isinstance(r.get("participants"), list)):
+            row = agg_by_cohort.setdefault(
+                len(r["participants"]),
+                {"count": 0, "total_agg_s": 0.0, "max_agg_s": 0.0})
+            row["count"] += 1
+            row["total_agg_s"] += r["time_agg"]
+            row["max_agg_s"] = max(row["max_agg_s"], r["time_agg"])
+    for row in agg_by_cohort.values():
+        row["mean_agg_s"] = row["total_agg_s"] / row["count"]
+
     # compression ratios: the comm.raw_bytes / comm.compressed_bytes
     # counter pair the compress subsystem records per message type
     compression = {}
@@ -171,6 +213,8 @@ def summarize(records: List[dict]) -> dict:
     return {
         "num_records": len(records),
         "num_rounds": len(rounds),
+        "round_latency": round_latency,
+        "agg_by_cohort": agg_by_cohort,
         "config": {k: config[k] for k in ("algorithm", "dataset", "model")
                    if config and k in config} if config else {},
         "rounds": rounds,
@@ -240,6 +284,20 @@ def render_text(path: str, s: dict, max_round_rows: int = 30) -> None:
         )
         print(f"    total  {total}")
         print(f"    mean   {mean}")
+
+    if s.get("round_latency"):
+        rl = s["round_latency"]
+        print("\n  round latency (close-to-close wall time, "
+              f"{rl['rounds_timed']} rounds):")
+        print(f"    p50 {_fmt_s(rl['p50_s'])}  p95 {_fmt_s(rl['p95_s'])}  "
+              f"max {_fmt_s(rl['max_s'])}  mean {_fmt_s(rl['mean_s'])}")
+    if s.get("agg_by_cohort"):
+        print("\n  close-time aggregation vs cohort size:")
+        for k in sorted(s["agg_by_cohort"]):
+            row = s["agg_by_cohort"][k]
+            print(f"    K={k:<4} rounds={row['count']:<4}"
+                  f"mean {_fmt_s(row['mean_agg_s'])}  "
+                  f"max {_fmt_s(row['max_agg_s'])}")
 
     if s["comm"]:
         print("\n  comm (per message type):")

@@ -441,9 +441,15 @@ class FedAvgServerManager(NodeManager):
             # the reference's FedAVGAggregator.py:59,85-86 aggregate timer
             get_telemetry().observe("span.agg_s", time_agg)
         # wall-clock close stamp: deltas between consecutive recs are
-        # the per-round wall time a federation artifact reports
+        # the per-round wall time a federation artifact reports; the
+        # monotonic open/close pair shares the hop-stamp clock
+        # (perf_counter), so fed_timeline can place round boundaries on
+        # the merged timeline without touching time.time at all
+        t_close_m = time.perf_counter()
         rec = {"round": self.round_idx, "participants": sorted(self.pending),
-               "time_agg": round(time_agg, 6), "t": round(time.time(), 3)}
+               "time_agg": round(time_agg, 6), "t": round(time.time(), 3),
+               "t_open_m": round(self._round_open_t, 6),
+               "t_close_m": round(t_close_m, 6)}
         missing = sorted(sampled - set(self.pending))
         if len(self.pending) >= self.clients_per_round:
             # the round closed at its K-report target: unreported nodes
@@ -482,6 +488,12 @@ class FedAvgServerManager(NodeManager):
                 "sampled %s) — global model unchanged this round",
                 self.round_idx, self.round_timeout or -1.0, sorted(sampled),
             )
+        # the same record as a telemetry event: the server's
+        # metrics-node0.jsonl then carries round boundaries next to its
+        # trace_hop chains, so the timeline merger reads ONE stream
+        tel.event("round_close", round=self.round_idx,
+                  participants=len(self.pending), time_agg=rec["time_agg"],
+                  t_open_m=rec["t_open_m"], t_close_m=rec["t_close_m"])
         self.round_log.append(rec)
         self.pending.clear()
         self._agg_acc, self._agg_n = None, 0.0

@@ -31,7 +31,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 from fedml_tpu.comm.message import Message
-from fedml_tpu.obs import comm_obs
+from fedml_tpu.obs import comm_obs, trace_ctx
 
 Handler = Callable[[Message], None]
 
@@ -92,6 +92,7 @@ class CommBackend(abc.ABC):
         # recv-side telemetry lives in the observer-notify path, so every
         # transport and every NodeManager is measured with no changes
         comm_obs.record_recv(msg.type, nbytes)
+        trace_ctx.on_recv(msg, self.node_id)
         for obs in list(self._observers):
             obs.receive_message(msg.type, msg)
 
@@ -122,6 +123,10 @@ class NodeManager(Observer):
     def receive_message(self, msg_type: str, msg: Message) -> None:
         handler = self._handlers.get(msg_type)
         if handler is None:
+            # the hop chain still emits: a dropped stray/late/duplicate
+            # frame's full path is exactly the evidence chaos triage
+            # wants in the merged timeline
+            trace_ctx.on_handled(msg, self.backend.node_id)
             # A stray or late frame (a post-deadline model upload, a
             # duplicate from a chaos run, a half-upgraded peer) is an
             # EXPECTED event in a fault-tolerant federation — raising
@@ -141,6 +146,10 @@ class NodeManager(Observer):
             # handler latency = the node's real work per message type
             # (server aggregate, client local train)
             comm_obs.record_handle(msg_type, time.perf_counter() - t0)
+            # 'done' stamp + trace_hop emission on the RECEIVER's
+            # registry: done - recv IS the handler (train/fold) time in
+            # the merged timeline
+            trace_ctx.on_handled(msg, self.backend.node_id)
 
     def send_message(self, msg: Message) -> None:
         self.backend.send_message(msg)

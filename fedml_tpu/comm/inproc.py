@@ -17,6 +17,7 @@ from typing import Dict
 
 from fedml_tpu.comm.backend import CommBackend
 from fedml_tpu.comm.message import Message
+from fedml_tpu.obs import trace_ctx
 from fedml_tpu.obs.comm_obs import message_nbytes
 
 
@@ -88,6 +89,11 @@ class InprocBackend(CommBackend):
 
     def send_message(self, msg: Message) -> None:
         t0 = time.perf_counter()
+        # hop stamps on the simulation bus too (no hub hops): the same
+        # msg OBJECT travels to the receiver, so stamping is strictly
+        # copy-on-write (trace_ctx.stamp_ctx forks the hop list)
+        trace_ctx.ensure(msg, self.node_id)
+        trace_ctx.stamp_msg(msg, self.node_id, "send")
         msg.wire_nbytes = message_nbytes(msg)
         self.bus.route(msg)
         self._record_send(msg, msg.wire_nbytes, time.perf_counter() - t0)

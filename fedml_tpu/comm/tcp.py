@@ -37,6 +37,7 @@ from typing import Dict, List, Tuple
 
 from fedml_tpu.comm.backend import CommBackend
 from fedml_tpu.comm.message import FRAME_BINLEN_KEY, Message
+from fedml_tpu.obs import trace_ctx
 from fedml_tpu.obs.telemetry import get_telemetry
 
 _SENTINEL = {"__hub__": "stop"}
@@ -106,13 +107,22 @@ class _Conn:
     only ever serviced by the one sender worker it was handed to), so
     per-connection order is FIFO and frames can never interleave
     mid-payload — the invariant the old per-conn send locks provided,
-    now without serializing the fan-out behind the router thread."""
+    now without serializing the fan-out behind the router thread.
+
+    Queue entries are ``(msg_type, parts, hdr, nbytes)``: for an
+    untraced frame ``hdr`` is None and ``parts`` is the complete wire
+    frame; for a TRACED frame ``hdr`` is the parsed header dict (shared
+    across an mcast's receiver queues) and ``parts`` holds only the
+    payload tail — the sender worker re-encodes the header line with a
+    fresh ``hub_out`` stamp at drain time, so ``hub_out - hub_in`` is
+    this frame's real queue wait and the payload bytes are still the
+    one shared immutable object."""
 
     __slots__ = ("sock", "frames", "nbytes", "scheduled")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
-        self.frames: deque = deque()  # (msg_type, parts) entries
+        self.frames: deque = deque()  # (msg_type, parts, hdr, nbytes)
         self.nbytes = 0
         self.scheduled = False
 
@@ -187,26 +197,62 @@ class TcpHub:
             # the registry), so that is the normal unregistered-
             # receiver drop, not a race.
             conn.sendall((json.dumps(_ACK) + "\n").encode())
-            st = _Conn(conn)
-            with self._lock:
-                self._conns[node_id] = st
+            # clock-sync phase: still UNREGISTERED (no sender worker can
+            # touch this conn), so ping replies may be written directly
+            # by this reader thread and are guaranteed to be the next
+            # line the dialer reads — the request/reply RTT is pure
+            # wire + scheduling, never queue wait.  The dialer ends the
+            # phase with ``ping_done``; registration happens then, so
+            # its min-RTT offset estimate is in hand before any routed
+            # frame can arrive.
             while True:
                 line = f.readline()
                 if not line:
-                    break
+                    return
                 try:
                     frame = json.loads(line)
                 except json.JSONDecodeError:
-                    # a garbled header is fatal for the CONNECTION, not
-                    # just the frame: since frames may carry binary
-                    # payloads, the stream cannot resynchronize — the
-                    # "bytes" that follow could be an unannounced
-                    # payload whose tail would parse as bogus headers
-                    # (worst case: a fabricated __binlen__ blocks this
-                    # thread on bytes that never arrive).  Dropping the
-                    # conn costs the peer one reconnect (its retry/
-                    # auto_reconnect path), never a wedged router.
+                    return  # garbled handshake: connection-fatal
+                kind = frame.get("__hub__")
+                if kind == "ping":
+                    conn.sendall((json.dumps({
+                        "__hub__": "pong",
+                        "t0": frame.get("t0"),
+                        "th": time.perf_counter(),
+                    }) + "\n").encode())
+                    continue
+                if kind == "ping_done":
                     break
+                # pre-handshake peers (an old dialer): fall through to
+                # registration and let the main loop service this line
+                break
+            st = _Conn(conn)
+            with self._lock:
+                self._conns[node_id] = st
+            pending = None if frame.get("__hub__") == "ping_done" \
+                else (line, frame)
+            while True:
+                if pending is not None:
+                    line, frame = pending
+                    pending = None
+                else:
+                    line = f.readline()
+                    if not line:
+                        break
+                    try:
+                        frame = json.loads(line)
+                    except json.JSONDecodeError:
+                        # a garbled header is fatal for the CONNECTION,
+                        # not just the frame: since frames may carry
+                        # binary payloads, the stream cannot
+                        # resynchronize — the "bytes" that follow could
+                        # be an unannounced payload whose tail would
+                        # parse as bogus headers (worst case: a
+                        # fabricated __binlen__ blocks this thread on
+                        # bytes that never arrive).  Dropping the conn
+                        # costs the peer one reconnect (its retry/
+                        # auto_reconnect path), never a wedged router.
+                        break
                 # v2 binary frame: the header announces exactly how many
                 # raw payload bytes follow — read them here so routing
                 # forwards header+payload as ONE unit and the readline
@@ -233,8 +279,31 @@ class TcpHub:
                         self.mcast_copies += len(receivers)
                     get_telemetry().inc("hub.mcast_frames",
                                         msg_type=mt or "?")
+                    # traced mcast (outer header flags it): split the
+                    # inner frame at its header line ONCE, stamp hub_in,
+                    # and queue (parsed header, shared payload-tail
+                    # view) per receiver — the sender worker re-encodes
+                    # the small header per copy with its own hub_out
+                    # stamp while the multi-MB tail stays one object
+                    hdr, tail = None, None
+                    if frame.get(trace_ctx.TRACE_KEY):
+                        nl = payload.find(b"\n")
+                        if nl >= 0:
+                            try:
+                                hdr = json.loads(payload[:nl + 1])
+                            except json.JSONDecodeError:
+                                hdr = None
+                        if hdr is not None and trace_ctx.TRACE_KEY in hdr:
+                            trace_ctx.hub_stamp(hdr, "hub_in")
+                            tail = memoryview(payload)[nl + 1:]
+                        else:
+                            hdr = None
                     for r in receivers:
-                        self._forward(r, (payload,), msg_type=mt)
+                        if hdr is not None:
+                            self._forward(r, (tail,), msg_type=mt,
+                                          hdr=hdr, nbytes=len(payload))
+                        else:
+                            self._forward(r, (payload,), msg_type=mt)
                     continue
                 if frame.get("__hub__") == "peers":
                     # membership introspection: reply to THIS node with
@@ -253,9 +322,20 @@ class TcpHub:
                     break
                 receiver = frame.get("receiver")
                 if receiver is not None:
-                    self._forward(receiver,
-                                  (line, payload) if payload else (line,),
-                                  msg_type=frame.get("msg_type"))
+                    if trace_ctx.TRACE_KEY in frame:
+                        # traced unicast: the line IS the header — stamp
+                        # hub_in on the parsed dict and let the sender
+                        # worker re-encode it with hub_out at drain
+                        trace_ctx.hub_stamp(frame, "hub_in")
+                        self._forward(receiver,
+                                      (payload,) if payload else (),
+                                      msg_type=frame.get("msg_type"),
+                                      hdr=frame,
+                                      nbytes=len(line) + len(payload))
+                    else:
+                        self._forward(receiver,
+                                      (line, payload) if payload else (line,),
+                                      msg_type=frame.get("msg_type"))
         except OSError:
             pass  # peer vanished: fall through to cleanup
         finally:
@@ -270,12 +350,19 @@ class TcpHub:
             except OSError:
                 pass
 
-    def _forward(self, receiver: int, parts: Tuple, msg_type=None):
-        """Enqueue one COMPLETE frame (header line [+ payload]) for
-        ``receiver``; the sender pool writes it.  Unknown receivers and
-        over-bound queues drop the frame — counted, by design (the
-        round deadline treats the receiver as a straggler)."""
-        nbytes = sum(len(p) for p in parts)
+    def _forward(self, receiver: int, parts: Tuple, msg_type=None,
+                 hdr=None, nbytes=None):
+        """Enqueue one frame for ``receiver``; the sender pool writes
+        it.  Untraced (``hdr=None``): ``parts`` is the COMPLETE frame
+        (header line [+ payload]).  Traced: ``hdr`` is the parsed
+        header dict (already ``hub_in``-stamped; shared across an
+        mcast's receiver queues) and ``parts`` holds only the payload
+        tail — the sender worker re-encodes the header line at drain
+        time.  Unknown receivers and over-bound queues drop the frame —
+        counted, by design (the round deadline treats the receiver as a
+        straggler)."""
+        if nbytes is None:
+            nbytes = sum(len(p) for p in parts)
         wake = False
         dropped = False
         with self._lock:
@@ -287,7 +374,7 @@ class TcpHub:
                 self.backpressure_drops += 1
                 dropped = True
             else:
-                st.frames.append((msg_type, parts))
+                st.frames.append((msg_type, parts, hdr, nbytes))
                 st.nbytes += nbytes
                 if not st.scheduled:
                     st.scheduled = True
@@ -315,10 +402,20 @@ class TcpHub:
                     if not st.frames:
                         st.scheduled = False
                         break
-                    msg_type, parts = st.frames.popleft()
-                    st.nbytes -= sum(len(p) for p in parts)
+                    msg_type, parts, hdr, nbytes = st.frames.popleft()
+                    st.nbytes -= nbytes
                 try:
-                    _sendall_parts(st.sock, parts)
+                    if hdr is not None:
+                        # traced frame: re-encode the (small) header
+                        # line with THIS copy's hub_out stamp at drain
+                        # time — hub_out - hub_in is this receiver's
+                        # real queue wait; the payload tail stays the
+                        # one shared immutable object
+                        _sendall_parts(
+                            st.sock, [trace_ctx.hub_out_line(hdr), *parts]
+                        )
+                    else:
+                        _sendall_parts(st.sock, parts)
                 except OSError:
                     # dead receiver: count this frame + everything still
                     # queued, deregister (its reader thread finishes
@@ -363,6 +460,47 @@ class TcpHub:
                 "mcast_frames": self.mcast_frames,
                 "mcast_copies": self.mcast_copies,
             }
+
+    def sample_telemetry(self, telemetry=None) -> dict:
+        """Snapshot ``stats()`` + per-connection send-queue depths into
+        the telemetry registry: gauges for live introspection plus one
+        ``hub_stats`` event per call.  ``run_hub`` calls this on a
+        timer and drains into ``metrics-hub.jsonl``, so a crashed or
+        SIGKILLed hub still leaves queue-depth / backpressure evidence
+        behind as a time series (the old behavior only printed stats at
+        a GRACEFUL exit) — and the per-sample ``t_m`` monotonic stamp
+        lets ``tools/fed_timeline.py`` line queue depth up against the
+        per-frame hop stamps, which share this clock."""
+        t = telemetry or get_telemetry()
+        with self._lock:
+            depths = {nid: (len(st.frames), st.nbytes)
+                      for nid, st in self._conns.items()}
+            snap = {
+                "dropped_frames": dict(self.dropped_frames),
+                "backpressure_drops": self.backpressure_drops,
+                "mcast_frames": self.mcast_frames,
+                "mcast_copies": self.mcast_copies,
+            }
+        for nid, (nframes, nbytes) in depths.items():
+            t.gauge_set("hub.send_queue_frames", nframes, node=nid)
+            t.gauge_set("hub.send_queue_bytes", nbytes, node=nid)
+        t.gauge_set("hub.connections", len(depths))
+        # _total suffix = cumulative monotonic counter exposed as a time
+        # series (diff successive samples for a rate); un-suffixed hub
+        # gauges (connections, send_queue_*) are instantaneous.  mcast
+        # copies lose their identity once queued, so no true in-flight
+        # mcast count exists to report
+        t.gauge_set("hub.backpressure_drops_total",
+                    snap["backpressure_drops"])
+        t.gauge_set("hub.mcast_frames_total", snap["mcast_frames"])
+        t.event(
+            "hub_stats", t_m=trace_ctx.now(),
+            connections=sorted(depths),
+            queue_frames={str(n): d[0] for n, d in depths.items()},
+            queue_bytes={str(n): d[1] for n, d in depths.items()},
+            **snap,
+        )
+        return snap
 
     def stop(self):
         self._running = False
@@ -435,6 +573,19 @@ class TcpBackend(CommBackend):
                     raise ConnectionError(
                         f"node {self.node_id}: no hub ACK"
                     )
+                # handshake phase 2: the hub does NOT register this
+                # conn until it reads ``ping_done`` (before that, its
+                # reader thread can reply to clock-sync pings directly
+                # with no sender-pool interleaving risk) — so EVERY
+                # dialer must end the phase, even an untraced one that
+                # sends no pings, or a receive-only node would never
+                # register and every frame to it would drop
+                if trace_ctx.enabled():
+                    self._clock_sync(sock, f)  # ping burst + ping_done
+                else:
+                    sock.sendall(
+                        (json.dumps({"__hub__": "ping_done"}) + "\n").encode()
+                    )
             except BaseException:
                 try:
                     sock.close()
@@ -452,6 +603,38 @@ class TcpBackend(CommBackend):
                     except OSError:
                         pass
             self._sock, self._file = sock, f
+
+    def _clock_sync(self, sock: socket.socket, f, pings: int = 8) -> None:
+        """NTP-style handshake ping burst (tracing on only): the hub is
+        still in its pre-registration phase for this conn, so replies
+        are written directly by its reader thread — the RTT is pure
+        wire + scheduling, never sender-pool queue wait.  The min-RTT
+        sample's midpoint estimates this process's monotonic-clock
+        offset to the hub (``trace_ctx.estimate_offset``), recorded as
+        a ``clock_sync`` telemetry event — what lets the timeline
+        merger place every process on the hub's clock.  ``ping_done``
+        ends the phase; only then does the hub register the conn."""
+        samples = []
+        for _ in range(pings):
+            t0 = time.perf_counter()
+            sock.sendall((json.dumps(
+                {"__hub__": "ping", "t0": t0}
+            ) + "\n").encode())
+            line = f.readline()
+            t1 = time.perf_counter()
+            if not line:
+                raise ConnectionError(
+                    f"node {self.node_id}: hub closed during clock sync"
+                )
+            pong = json.loads(line)
+            if pong.get("__hub__") != "pong":
+                raise ConnectionError(
+                    f"node {self.node_id}: bad clock-sync reply {pong!r}"
+                )
+            samples.append((t0, pong.get("th"), t1))
+        sock.sendall((json.dumps({"__hub__": "ping_done"}) + "\n").encode())
+        offset, rtt = trace_ctx.estimate_offset(samples)
+        trace_ctx.record_clock_sync(self.node_id, offset, rtt, len(samples))
 
     def _send_parts(self, parts: List, msg_type: str) -> None:
         """Bounded-retry vectored write of one complete frame.
@@ -489,9 +672,16 @@ class TcpBackend(CommBackend):
         # JSON strings) — either way ONE complete frame, written
         # atomically (vectored) under the send lock
         t0 = time.perf_counter()
+        trace_ctx.ensure(msg, self.node_id)
         if self.wire >= 2:
-            parts = msg.to_frame_parts()
+            # restamp_parts re-encodes ONLY the header line around the
+            # memoized encoding (payload views shared by identity) — a
+            # no-op returning the memoized list when untraced
+            parts = trace_ctx.restamp_parts(
+                msg, msg.to_frame_parts(), self.node_id, "send"
+            )
         else:
+            trace_ctx.stamp_msg(msg, self.node_id, "send")
             parts = [(msg.to_json() + "\n").encode()]
         self._send_parts(parts, msg.type)
         # exact wire bytes; latency covers serialize + socket write
@@ -515,12 +705,21 @@ class TcpBackend(CommBackend):
             super().send_multicast(msg, receivers)
             return
         t0 = time.perf_counter()
+        trace_ctx.ensure(msg, self.node_id)
         inner = msg.to_frame_parts()  # encode ONCE for the whole cohort
+        traced = trace_ctx.TRACE_KEY in msg.params
+        if traced:
+            # one 'send' stamp shared by the whole cohort (the frame IS
+            # one object); per-copy divergence begins at the hub's
+            # per-receiver hub_out restamp
+            inner = trace_ctx.restamp_parts(msg, inner, self.node_id, "send")
         head = (json.dumps({
             "__hub__": "mcast",
             "receivers": receivers,
             "msg_type": msg.type,
+            # binlen AFTER the restamp: the inner header line grew
             FRAME_BINLEN_KEY: sum(len(p) for p in inner),
+            **({trace_ctx.TRACE_KEY: True} if traced else {}),
         }) + "\n").encode()
         parts = [head, *inner]
         self._send_parts(parts, msg.type)

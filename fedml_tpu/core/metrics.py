@@ -42,13 +42,18 @@ class MetricsLogger:
         use_wandb: bool = False,
         wandb_kwargs: Optional[dict] = None,
         telemetry: Optional[Telemetry] = None,
+        filename: str = "metrics.jsonl",
     ):
         self.run_dir = run_dir
         self.telemetry = telemetry or get_telemetry()
         self._fh = None
         if run_dir:
             os.makedirs(run_dir, exist_ok=True)
-            self._fh = open(os.path.join(run_dir, "metrics.jsonl"), "a")
+            # ``filename`` lets every federation PROCESS log into one
+            # shared run_dir without interleaving: hub/server/clients
+            # each append to their own metrics-node<id>.jsonl, and
+            # tools/fed_timeline.py merges the set
+            self._fh = open(os.path.join(run_dir, filename), "a")
         self._wandb = None
         if use_wandb:
             try:
@@ -99,6 +104,22 @@ class MetricsLogger:
                   **self.telemetry.snapshot()}
         self._write(record)
         return record
+
+    def flush_events(self) -> int:
+        """Drain pending telemetry events into the record stream WITHOUT
+        the counter snapshot ``log_telemetry`` appends.  The registry's
+        event ring is bounded (4096): a long traced federation run emits
+        tens of ``trace_hop`` events per round, so an exit-time-only
+        drain silently evicts the earliest chains — and the single
+        ``clock_sync`` event, stamped at dial time, goes first, which
+        would skew every stamp of that process in the merged timeline.
+        Call this on a timer (``distributed_fedavg`` worker processes
+        do) and keep ``log_telemetry`` for the final snapshot."""
+        n = 0
+        for ev in self.telemetry.drain_events():
+            self._write(ev)
+            n += 1
+        return n
 
     @contextlib.contextmanager
     def span(self, name: str):

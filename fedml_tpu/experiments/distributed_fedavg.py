@@ -127,7 +127,49 @@ def _resolve_crash_round(flag_value: int, plan, node_id: int):
     return None
 
 
-def run_hub(host: str, port: int) -> None:
+def _node_metrics_logger(run_dir: str, tag):
+    """Per-process metrics sink: each federation participant appends to
+    its OWN ``metrics-<tag>.jsonl`` inside the shared run_dir, so
+    concurrent processes never interleave into one file and
+    ``tools/fed_timeline.py`` can merge the set.  Returns None when no
+    run_dir was requested (the legacy stdout-only mode)."""
+    if not run_dir:
+        return None
+    from fedml_tpu.core.metrics import MetricsLogger
+
+    return MetricsLogger(run_dir=run_dir, filename=f"metrics-{tag}.jsonl")
+
+
+def _start_event_flusher(mlog, interval: float = 1.0):
+    """Periodically drain the telemetry event ring into this process's
+    metrics file while the main thread is blocked in ``backend.run()``.
+    The ring holds 4096 events and a traced run emits ~participants
+    ``trace_hop`` events per round, so exit-time-only draining evicts
+    the earliest chains (the one ``clock_sync`` event first) on long
+    runs.  Returns a stop callable; call it BEFORE the final
+    ``log_telemetry`` so only one thread ever writes at a time."""
+    if mlog is None:
+        return lambda: None
+    import threading
+
+    stop = threading.Event()
+
+    def _loop():
+        while not stop.wait(interval):
+            mlog.flush_events()
+
+    t = threading.Thread(target=_loop, daemon=True)
+    t.start()
+
+    def _stop():
+        stop.set()
+        t.join(timeout=5)
+
+    return _stop
+
+
+def run_hub(host: str, port: int, run_dir: str = "",
+            stats_interval: float = 1.0) -> None:
     from fedml_tpu.comm.tcp import TcpHub
 
     hub = TcpHub(host, port)
@@ -140,11 +182,26 @@ def run_hub(host: str, port: int) -> None:
 
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
+    mlog = _node_metrics_logger(run_dir, "hub")
+    last_sample = time.monotonic()
     try:
         while not stop["flag"]:
             time.sleep(0.1)
+            if mlog is not None and (
+                time.monotonic() - last_sample >= stats_interval
+            ):
+                # periodic snapshot INTO THE FILE, not just the exit
+                # print: a crashed/SIGKILLed hub still leaves its
+                # queue-depth / backpressure time series behind
+                last_sample = time.monotonic()
+                hub.sample_telemetry()
+                mlog.log_telemetry()
     finally:
         hub.stop()
+        if mlog is not None:
+            hub.sample_telemetry()
+            mlog.log_telemetry()
+            mlog.close()
         # hub-side fault accounting for the launcher (dropped frames by
         # message type — chaos runs reconcile these against injections)
         print(json.dumps({"hub_stats": hub.stats()}), flush=True)
@@ -201,6 +258,12 @@ def run_server(args) -> None:
     # (~8 s each), so a fixed 60 s cap spuriously fails at N >= ~8
     backend.await_peers(range(1, args.num_clients + 1),
                         timeout=60 + 15 * args.num_clients)
+    # the metrics sink opens BEFORE the round loop and a flusher thread
+    # drains the bounded event ring on a timer: a long traced run would
+    # otherwise evict clock_sync + early trace_hop chains before the
+    # exit-time drain (deque maxlen=4096)
+    mlog = _node_metrics_logger(args.run_dir, "node0")
+    stop_flusher = _start_event_flusher(mlog)
     server.start()
     backend.run()  # returns when finish() closes the socket
     if args.out:
@@ -211,6 +274,14 @@ def run_server(args) -> None:
             rounds=server.round_idx,
             round_log=json.dumps(server.round_log),
         )
+    # final drain: stop the flusher first so only one thread writes,
+    # then the full registry (remaining events + counter/histogram
+    # snapshot) lands in the server's own metrics file — the
+    # per-process record fed_timeline merges
+    stop_flusher()
+    if mlog is not None:
+        mlog.log_telemetry()
+        mlog.close()
     # fault accounting alongside the round count: the process-local
     # telemetry registry dies with this process, so surface the chaos
     # counters on stdout where the launcher/chaos driver collects them
@@ -271,7 +342,19 @@ def run_client(args) -> None:
             args.crash_at_round, plan, args.node_id
         ),
     )
+    # the client's registry used to die here with nothing but a stdout
+    # counter dump — now the whole thing (trace_hop chains, clock_sync,
+    # comm counters, handle-latency histograms) lands in this process's
+    # own metrics-node<id>.jsonl for the timeline merger; the flusher
+    # thread keeps the bounded event ring from evicting early chains
+    # on long runs
+    mlog = _node_metrics_logger(args.run_dir, f"node{args.node_id}")
+    stop_flusher = _start_event_flusher(mlog)
     backend.run()  # returns on FINISH
+    stop_flusher()
+    if mlog is not None:
+        mlog.log_telemetry()
+        mlog.close()
     # reproducibility probe: the accumulated sha256 of every encoded
     # upload — two runs at the same seed must print identical digests
     # (the launcher collects these when asked)
@@ -304,6 +387,8 @@ def launch(
     input_dim: int = 8,
     hotpath: str = "fast",
     train_samples: int = 60,
+    run_dir: str = "",
+    trace: bool = False,
     info=None,
     env=None,
     server_env=None,
@@ -340,19 +425,30 @@ def launch(
       final stdout JSON (fault counters) and the hub's shutdown stats.
     """
     env = dict(env or os.environ)
+    if server_env is not None:
+        server_env = dict(server_env)
     if chaos_plan:
         env["FEDML_TPU_CHAOS"] = chaos_plan
         if server_env is not None:
-            server_env = dict(server_env)
             server_env["FEDML_TPU_CHAOS"] = chaos_plan
+    if trace:
+        # distributed tracing rides the env: every process (hub,
+        # server, clients) stamps hops and shares one run id so the
+        # merged timeline is self-correlating
+        extra = {"FEDML_TPU_TRACE": "1",
+                 "FEDML_TPU_RUN_ID": f"fed-s{seed}-n{num_clients}"}
+        env.update(extra)
+        if server_env is not None:
+            server_env.update(extra)
     me = [sys.executable, "-m", "fedml_tpu.experiments.distributed_fedavg"]
+    rd_flags = ["--run-dir", run_dir] if run_dir else []
     hub = None
     hubs = []
     procs = []
     killed_registered_peer = False
     try:
         hub = subprocess.Popen(
-            me + ["--role", "hub", "--port", "0"],
+            me + ["--role", "hub", "--port", "0"] + rd_flags,
             stdout=subprocess.PIPE, text=True, env=env,
         )
         hubs.append(hub)
@@ -362,7 +458,8 @@ def launch(
         port = json.loads(port_line)["hub_port"]
         common = ["--host", "127.0.0.1", "--port", str(port),
                   "--num-clients", str(num_clients), "--rounds", str(rounds),
-                  "--seed", str(seed), "--batch-size", str(batch_size)]
+                  "--seed", str(seed), "--batch-size", str(batch_size)] \
+            + rd_flags
         if codec and codec != "none":
             common += ["--codec", codec]
         if wire != 2:
@@ -438,7 +535,7 @@ def launch(
             hub.wait(timeout=10)
             time.sleep(0.5)  # a beat of real downtime
             hub = subprocess.Popen(
-                me + ["--role", "hub", "--port", str(port)],
+                me + ["--role", "hub", "--port", str(port)] + rd_flags,
                 stdout=subprocess.PIPE, text=True, env=env,
             )
             hubs.append(hub)
@@ -541,9 +638,20 @@ def main(argv=None):
     # so latency runs can pick a comm-dominant regime
     p.add_argument("--hotpath", choices=["fast", "legacy"], default="fast")
     p.add_argument("--train-samples", type=int, default=60)
+    # observability knobs: --run-dir makes EVERY process (hub included)
+    # append its telemetry registry to its own metrics-<tag>.jsonl in
+    # the shared directory; --trace turns on per-hop distributed trace
+    # stamping (equivalent to FEDML_TPU_TRACE=1 in the environment);
+    # --stats-interval paces the hub's periodic gauge snapshot
+    p.add_argument("--run-dir", default="")
+    p.add_argument("--trace", action="store_true")
+    p.add_argument("--stats-interval", type=float, default=1.0)
     args = p.parse_args(argv)
+    if args.trace:
+        # before any comm import reads (and caches) the switch
+        os.environ["FEDML_TPU_TRACE"] = "1"
     if args.role == "hub":
-        run_hub(args.host, args.port)
+        run_hub(args.host, args.port, args.run_dir, args.stats_interval)
     elif args.role == "server":
         run_server(args)
     else:

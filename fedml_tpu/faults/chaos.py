@@ -41,6 +41,7 @@ import numpy as np
 from fedml_tpu.comm.backend import CommBackend, Observer
 from fedml_tpu.comm.message import Message
 from fedml_tpu.faults.plan import FaultPlan
+from fedml_tpu.obs import trace_ctx
 from fedml_tpu.obs.telemetry import get_telemetry
 
 
@@ -198,7 +199,12 @@ class ChaosBackend(CommBackend):
                     self._inject("corrupt", msg_type)
             elif kind == "duplicate":
                 self._inject("duplicate", msg_type)
-                forward(msg)
+                # the extra copy gets its own trace identity (copy+1,
+                # fresh clone => fresh frame encoding): the two
+                # deliveries are distinguishable in the merged timeline
+                # and neither aliases the other's hop stamps (untraced
+                # messages pass through fork_copy unchanged)
+                forward(trace_ctx.fork_copy(msg))
             elif kind in ("delay", "reorder"):
                 delay = a
             elif kind == "disconnect":
@@ -261,6 +267,12 @@ class ChaosBackend(CommBackend):
 
     # -- CommBackend surface ------------------------------------------------
     def send_message(self, msg: Message) -> None:
+        # attach the trace ctx BEFORE fault application (the inner
+        # transport would only do it at its own send): a duplicate's
+        # fork_copy needs an existing ctx to give the extra copy its
+        # own identity — without this both inproc deliveries would
+        # share one params dict and alias their hop stamps
+        trace_ctx.ensure(msg, self.node_id)
         self._apply("send", msg, self.inner.send_message,
                     receiver=msg.receiver)
 
@@ -275,6 +287,7 @@ class ChaosBackend(CommBackend):
         receivers = [int(r) for r in receivers]
         if not receivers:
             return
+        trace_ctx.ensure(msg, self.node_id)  # see send_message
         if not self.plan.applies_to(msg.type):
             self.inner.send_multicast(msg, receivers)
             # one tick PER RECEIVER, exactly like the K-unicast loop

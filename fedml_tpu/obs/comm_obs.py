@@ -25,7 +25,13 @@ from typing import Optional
 
 from fedml_tpu.obs.telemetry import Telemetry, get_telemetry
 
-_B64_FACTOR = 4.0 / 3.0  # base64 expansion of binary buffers on the wire
+# base64 expansion of binary buffers on the wire — applies ONLY to
+# legacy wiretree-v1 values (b64 leaf dicts, or raw arrays serialized
+# through the v1 JSON line).  The default wire since the compression
+# subsystem is the v2 binary frame codec, whose raw-array accounting is
+# EXACT (length-prefixed buffers + the ~48-byte __ndbuf__ header entry);
+# ``message_nbytes`` estimates that framing unless asked for version=1.
+_B64_FACTOR = 4.0 / 3.0
 
 
 def record_send(msg_type: str, nbytes: Optional[int], seconds: Optional[float],
@@ -76,22 +82,28 @@ def record_unhandled(msg_type: str,
     t.inc("faults.observed", 1, kind="unhandled_msg", msg_type=msg_type)
 
 
-def _value_nbytes(v, binary: bool = False) -> float:
+def _value_nbytes(v, binary: bool = True) -> float:
     """Approximate serialized size of one params value (see message.py
     codecs) WITHOUT encoding it — inproc skips serialization entirely,
     so its byte accounting must not pay a full ``to_json`` per message.
 
-    ``binary`` marks a wiretree-v2 context: raw arrays there ship as
-    exact length-prefixed buffers (``Message.to_frame``), so their
-    accounting is EXACT (nbytes + the ~48-byte ``__ndbuf__`` header
-    entry); legacy v1 values keep the base64 4/3x estimate."""
+    ``binary`` (the default — v2 binary framing is the wire default):
+    raw arrays ship as exact length-prefixed buffers
+    (``Message.to_frame``), so their accounting is EXACT (nbytes + the
+    ~48-byte ``__ndbuf__`` header entry).  ``binary=False`` models the
+    legacy v1 JSON line, where raw arrays b64-inflate by ``_B64_FACTOR``
+    — the only path the factor still applies to (already-b64
+    ``__ndarray__`` dicts are length-counted directly either way)."""
     if isinstance(v, dict):
         if "__ndarray__" in v:  # already-encoded array: b64 string length
             return len(v["__ndarray__"]) + 48
         if "__ndbuf__" in v:  # binary buffer reference: exact
             return float(v["__ndbuf__"][1]) + 48
         if "__wiretree__" in v:  # wire pytree: sum its encoded leaves
-            exact = v.get("__wiretree__") == 2
+            # a v2 tree's raw leaves are only exact when the FRAME is
+            # binary too; through a v1 JSON line they b64-encode like
+            # any array (the interop contract in message.py)
+            exact = v.get("__wiretree__") == 2 and binary
             return sum(_value_nbytes(l, binary=exact)
                        for l in v.get("leaves", ())) + 32
         return sum(len(str(k)) + 4 + _value_nbytes(x, binary)
@@ -112,7 +124,11 @@ def _value_nbytes(v, binary: bool = False) -> float:
     return len(str(v))
 
 
-def message_nbytes(msg) -> int:
-    """Estimated JSON-line wire size of a ``Message`` envelope."""
-    return int(sum(len(k) + 4 + _value_nbytes(v)
+def message_nbytes(msg, version: int = 2) -> int:
+    """Estimated wire size of a ``Message`` envelope without
+    serializing it.  ``version=2`` (default): the binary frame codec —
+    raw arrays counted exactly.  ``version=1``: the legacy JSON line,
+    raw arrays inflated by the b64 factor."""
+    binary = version >= 2
+    return int(sum(len(k) + 4 + _value_nbytes(v, binary)
                    for k, v in msg.params.items()) + 2)

@@ -628,3 +628,152 @@ def test_hub_counts_dropped_frames_by_type():
         "hub.dropped_frames", msg_type="C2S_SEND_MODEL") >= 1
     sender.stop()
     hub.stop()
+
+
+# ---------------------------------------------------------------------------
+# Trace-context propagation under chaos (ISSUE 6)
+# ---------------------------------------------------------------------------
+
+def test_chaos_duplicate_copies_get_distinct_trace_identity():
+    """A chaos duplicate's two deliveries must be distinguishable in
+    the merged timeline: distinct ``copy`` ids, non-aliased hop lists
+    (stamping is copy-on-write), and distinct stamp times."""
+    from fedml_tpu.obs import trace_ctx
+
+    trace_ctx.set_enabled(True)
+    try:
+        bus = InprocBus()
+        recv = bus.register(0)
+        raw_send = bus.register(1)
+        plan = FaultPlan(0, rules=[FaultRule(action="duplicate",
+                                             msg_type="C2S_SEND_MODEL")])
+        send = ChaosBackend(raw_send, plan)
+        got = []
+
+        class Obs:
+            def receive_message(self, t, m):
+                got.append(m)
+
+        recv.add_observer(Obs())
+        m = Message(MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+        m.add_params(MSG_ARG_KEY_MODEL_PARAMS,
+                     tree_to_wire({"w": np.ones(8, np.float32)}))
+        m.add_params(MSG_ARG_KEY_ROUND_INDEX, 0)
+        send.send_message(m)
+        bus.drain()
+        assert len(got) == 2
+        ctxs = [g.params[trace_ctx.TRACE_KEY] for g in got]
+        assert sorted(c.get("copy", 0) for c in ctxs) == [0, 1]
+        assert ctxs[0]["hops"] is not ctxs[1]["hops"]
+        for c in ctxs:
+            assert [h[1] for h in c["hops"]] == ["send", "recv"]
+        # per-copy stamps are real per-delivery times, not shared
+        assert ctxs[0]["hops"][0][2] != ctxs[1]["hops"][0][2]
+    finally:
+        trace_ctx.set_enabled(None)
+
+
+def test_chaos_reorder_trace_stamps_follow_true_delivery_order():
+    """A reordered (delay_msgs=1) frame gets its own coherent hop chain
+    whose stamps reflect what ACTUALLY happened: the chaos hold sits
+    upstream of the transport, so the held message's send stamp lands
+    at release time — after the overtaker's — and its recv follows.
+    The swap is fully visible in the merged timeline."""
+    from fedml_tpu.obs import trace_ctx
+
+    trace_ctx.set_enabled(True)
+    try:
+        bus = InprocBus()
+        recv = bus.register(0)
+        raw_send = bus.register(1)
+        plan = FaultPlan(0, rules=[FaultRule(
+            action="reorder", msg_type="C2S_SEND_MODEL", round=0)])
+        send = ChaosBackend(raw_send, plan)
+        got = []
+
+        class Obs:
+            def receive_message(self, t, m):
+                got.append(m)
+
+        recv.add_observer(Obs())
+        for rnd in (0, 1):  # the rule holds ONLY the round-0 message
+            m = Message(MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+            m.add_params(MSG_ARG_KEY_MODEL_PARAMS,
+                         tree_to_wire({"w": np.full(4, float(rnd),
+                                                    np.float32)}))
+            m.add_params(MSG_ARG_KEY_ROUND_INDEX, rnd)
+            send.send_message(m)
+        bus.drain()
+        assert [g.get(MSG_ARG_KEY_ROUND_INDEX) for g in got] == [1, 0]
+        stamps = {g.get(MSG_ARG_KEY_ROUND_INDEX):
+                  {h[1]: h[2] for h in g.params[trace_ctx.TRACE_KEY]["hops"]}
+                  for g in got}
+        # the held message hit the transport (send) and the receiver
+        # (recv) after the message that overtook it
+        assert stamps[0]["send"] > stamps[1]["send"]
+        assert stamps[0]["recv"] > stamps[1]["recv"]
+        # and each chain is internally coherent
+        for s in stamps.values():
+            assert s["send"] <= s["recv"]
+    finally:
+        trace_ctx.set_enabled(None)
+
+
+def test_chaos_tcp_duplicate_payload_intact_and_memo_unmutated():
+    """Over the real hub: a duplicated multi-buffer v2 frame decodes
+    byte-identical on both deliveries (chaos never corrupts the
+    memoized frame parts) and each copy's hub hop stamps are its own."""
+    from fedml_tpu.comm.message import tree_from_wire
+    from fedml_tpu.comm.tcp import TcpBackend, TcpHub
+    from fedml_tpu.obs import trace_ctx
+
+    trace_ctx.set_enabled(True)
+    hub = TcpHub()
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+
+    recv = TcpBackend(0, hub.host, hub.port)
+    recv.add_observer(Obs())
+    recv.run_in_thread()
+    plan = FaultPlan(0, rules=[FaultRule(action="duplicate",
+                                         msg_type="C2S_SEND_MODEL")])
+    send = ChaosBackend(TcpBackend(1, hub.host, hub.port), plan)
+    try:
+        send.await_peers([0])
+        tree = {"w": np.arange(5000, dtype=np.float32),
+                "b": np.ones(7, np.float32)}
+        m = Message(MSG_TYPE_C2S_SEND_MODEL, 1, 0)
+        m.add_params(MSG_ARG_KEY_MODEL_PARAMS, tree_to_wire(tree))
+        m.add_params(MSG_ARG_KEY_ROUND_INDEX, 0)
+        send.send_message(m)
+        memo = m._frame_parts
+        deadline = time.time() + 10
+        while len(got) < 2 and time.time() < deadline:
+            time.sleep(0.01)
+        assert len(got) == 2
+        assert m._frame_parts is memo  # never invalidated mid-flight
+        for g in got:
+            back = tree_from_wire(g.get(MSG_ARG_KEY_MODEL_PARAMS), tree)
+            np.testing.assert_array_equal(np.asarray(back["w"]), tree["w"])
+            np.testing.assert_array_equal(np.asarray(back["b"]), tree["b"])
+        ctxs = [g.params[trace_ctx.TRACE_KEY] for g in got]
+        assert sorted(c.get("copy", 0) for c in ctxs) == [0, 1]
+        for c in ctxs:
+            assert [h[1] for h in c["hops"]] \
+                == ["send", "hub_in", "hub_out", "recv"]
+        # each copy traversed the hub separately: its own queue stamps
+        hub_ts = sorted(
+            tuple(h[2] for h in c["hops"] if h[0] == "hub") for c in ctxs
+        )
+        assert hub_ts[0] != hub_ts[1]
+    finally:
+        send.stop()
+        recv.stop()
+        hub.stop()
+        trace_ctx.set_enabled(None)
+        from fedml_tpu.obs.telemetry import get_telemetry
+
+        get_telemetry().drain_events()

@@ -311,3 +311,92 @@ def test_trace_default_dir_from_logger_run_dir(tmp_path):
     assert any(r.get("kind") == "trace" and r.get("trace_dir") == tdir
                for r in recs)
     assert os.path.isdir(tdir)  # the profiler actually wrote there
+
+
+# --- distributed trace context (ISSUE 6) ------------------------------------
+
+from fedml_tpu.obs import trace_ctx  # noqa: E402
+
+
+def test_clock_offset_estimator_synthetic_skew():
+    """Pure-function NTP estimator: the min-RTT sample's midpoint wins,
+    so a symmetric tight ping recovers a synthetic skew exactly even
+    when noisier asymmetric samples surround it."""
+    skew = 41.7  # hub monotonic clock = local + skew
+    samples = [
+        (11.0, 11.015 + skew, 11.020),   # asymmetric, 20 ms RTT: loses
+        (10.0, 10.0005 + skew, 10.001),  # symmetric 1 ms RTT: wins
+        (12.0, None, 12.001),            # unusable reply
+        (13.002, 13.0 + skew, 13.001),   # negative RTT: skipped
+    ]
+    off, rtt = trace_ctx.estimate_offset(samples)
+    assert rtt == pytest.approx(0.001)
+    # error bound is rtt/2 by construction; this sample is symmetric so
+    # the estimate is exact up to float noise
+    assert off == pytest.approx(skew, abs=1e-9)
+    assert trace_ctx.estimate_offset([]) == (None, None)
+    assert trace_ctx.estimate_offset([(1.0, None, 1.1)]) == (None, None)
+
+
+def test_trace_ctx_stamps_are_copy_on_write():
+    """Stamping forks the hop list: on inproc the SAME params objects
+    are shared between sender/receiver/duplicate copies, so an in-place
+    append would alias every copy's chain."""
+    trace_ctx.set_enabled(True)
+    try:
+        ctx = trace_ctx.new_ctx(3, round_idx=2)
+        assert ctx["hops"] == [] and ctx["rnd"] == 2 and "t0" in ctx
+        a = trace_ctx.stamp_ctx(ctx, 3, "send")
+        b = trace_ctx.stamp_ctx(ctx, "hub", "hub_in")
+        assert ctx["hops"] == []  # base never mutated
+        assert [h[:2] for h in a["hops"]] == [[3, "send"]]
+        assert [h[:2] for h in b["hops"]] == [["hub", "hub_in"]]
+    finally:
+        trace_ctx.set_enabled(None)
+
+
+def test_restamp_parts_reuses_payload_buffers_and_memo():
+    """The zero-copy contract under stamping: restamp_parts re-encodes
+    ONLY the header line — payload buffers are the same objects by
+    identity, the memoized list is never mutated, and an untraced
+    message passes through without any JSON work."""
+    from fedml_tpu.comm.message import Message
+
+    trace_ctx.set_enabled(True)
+    try:
+        m = Message("T", 1, 0)
+        m.add_params("w", np.arange(4096, dtype=np.float32))
+        trace_ctx.ensure(m, 1)
+        parts = m.to_frame_parts()
+        stamped = trace_ctx.restamp_parts(m, parts, 1, "send")
+        assert stamped is not parts
+        assert all(s is p for s, p in zip(stamped[1:], parts[1:]))
+        assert m.to_frame_parts() is parts  # memo untouched
+        hdr = json.loads(bytes(stamped[0]))
+        assert [h[:2] for h in hdr[trace_ctx.TRACE_KEY]["hops"]] \
+            == [[1, "send"]]
+        # the memoized header still carries the UNstamped ctx
+        assert json.loads(bytes(parts[0]))[trace_ctx.TRACE_KEY]["hops"] == []
+        plain = Message("T", 1, 0)
+        plain.add_params("w", np.arange(8, dtype=np.float32))
+        pp = plain.to_frame_parts()
+        assert trace_ctx.restamp_parts(plain, pp, 1, "send") is pp
+    finally:
+        trace_ctx.set_enabled(None)
+
+
+def test_trace_disabled_attaches_nothing():
+    from fedml_tpu.comm.message import Message
+
+    trace_ctx.set_enabled(False)
+    try:
+        m = Message("T", 1, 0)
+        trace_ctx.ensure(m, 1)
+        assert trace_ctx.TRACE_KEY not in m.params
+        # stamping helpers are no-ops without a ctx
+        trace_ctx.stamp_msg(m, 1, "send")
+        trace_ctx.on_recv(m, 1)
+        assert trace_ctx.TRACE_KEY not in m.params
+        assert trace_ctx.fork_copy(m) is m
+    finally:
+        trace_ctx.set_enabled(None)

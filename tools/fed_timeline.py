@@ -1,0 +1,414 @@
+#!/usr/bin/env python
+"""Merge a federation run's per-process metrics files into ONE timeline.
+
+Input: a run_dir written by ``experiments/distributed_fedavg.py
+--run-dir`` with tracing on (``--trace`` / ``FEDML_TPU_TRACE=1``):
+``metrics-node<id>.jsonl`` per participant plus ``metrics-hub.jsonl``.
+Each file carries that process's ``trace_hop`` chains (per-hop monotonic
+stamps: send → hub_in → hub_out → recv → done), its ``clock_sync``
+handshake offset estimate, the server's ``round_close`` boundaries, and
+the hub's periodic ``hub_stats`` queue-depth samples.
+
+The merger places every stamp on the HUB's monotonic clock
+(``t_hub = t_local + offset[node]``, min-RTT NTP estimate from
+``obs/trace_ctx.estimate_offset``; loopback uncertainty ~ tens of
+microseconds) and reconstructs, per round, the measured critical path:
+
+    serialize → hub queue (broadcast) → fan-out deliver → client train
+    → upload serialize → upload wire → hub queue (upload) → deliver
+    → decode+fold → close
+
+The per-round critical chain follows the LAST upload the server needed
+(the one whose arrival closed the round) — its client's sync copy, its
+train span, its upload's hub hops — so the breakdown is an actual path
+through one message chain, not a sum of averages.  Cohort-wide stats
+(mean/max hub queue wait, train spread) ride alongside.
+
+Outputs:
+
+- human-readable per-round table + aggregate p50 attribution (default);
+- ``--json``: the same as one JSON object;
+- ``--perfetto OUT.json``: Chrome trace-event JSON (open in Perfetto /
+  chrome://tracing) — one track per process, slices for every measured
+  span, counter tracks for the hub's per-connection send-queue depth.
+
+Usage: python tools/fed_timeline.py RUN_DIR [--json] [--perfetto OUT]
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import sys
+from typing import Dict, List, Optional
+
+HUB = "hub"
+SYNC_TYPES = ("S2C_INIT_CONFIG", "S2C_SYNC_MODEL")
+UPLOAD_TYPE = "C2S_SEND_MODEL"
+
+# breakdown phases in critical-path order (the report's row order)
+PHASES = [
+    "serialize", "bcast_queue", "bcast_deliver", "client_train",
+    "upload_serialize", "upload_wire", "upload_queue", "upload_deliver",
+    "decode_fold", "close",
+]
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    recs = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                recs.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # torn tail of a killed process: keep the rest
+    return recs
+
+
+def load_run(run_dir: str) -> dict:
+    """Parse every metrics-*.jsonl in ``run_dir`` into one bundle."""
+    files = sorted(glob.glob(os.path.join(run_dir, "metrics-*.jsonl")))
+    if not files:
+        raise SystemExit(f"no metrics-*.jsonl files in {run_dir!r} "
+                         "(run with --run-dir and --trace)")
+    offsets: Dict[object, float] = {HUB: 0.0}
+    resynced: Dict[object, int] = {}
+    hops: List[dict] = []
+    rounds: List[dict] = []
+    hub_stats: List[dict] = []
+    for path in files:
+        for rec in _read_jsonl(path):
+            kind = rec.get("kind")
+            if kind == "clock_sync":
+                node, off = rec["node"], float(rec["offset_s"])
+                # a second handshake for the same node means the hub
+                # process (the clock every offset is relative to) was
+                # replaced mid-run: stamps before/after the restart live
+                # on unrelated monotonic origins
+                if node in offsets and node != HUB and \
+                        abs(offsets[node] - off) > 1e-3:
+                    resynced[node] = resynced.get(node, 1) + 1
+                offsets[node] = off
+            elif kind == "trace_hop":
+                hops.append(rec)
+            elif kind == "round_close":
+                rounds.append(rec)
+            elif kind == "hub_stats":
+                hub_stats.append(rec)
+    if resynced:
+        print("WARNING: nodes re-ran the clock-sync handshake with a "
+              f"materially different offset ({sorted(resynced)}): the hub "
+              "was restarted mid-run, so hop stamps from the two hub "
+              "processes sit on unrelated monotonic clocks and each "
+              "node's PRE-restart stamps are mapped with its POST-restart "
+              "offset (last sync wins).  Per-round spans crossing the "
+              "restart are unreliable — trust only rounds entirely on "
+              "one side of it.", file=sys.stderr)
+    rounds.sort(key=lambda r: r.get("round", -1))
+    return {"offsets": offsets, "hops": hops, "rounds": rounds,
+            "hub_stats": hub_stats, "files": files,
+            "clock_resync_nodes": sorted(resynced)}
+
+
+def _hub_t(offsets: dict, node, t: float) -> float:
+    """Map one stamp onto the hub clock; unknown nodes (inproc runs, a
+    node whose handshake predates tracing) fall back to offset 0."""
+    return t + offsets.get(node, 0.0)
+
+
+def _hop_map(rec: dict, offsets: dict) -> Dict[str, float]:
+    """hop list -> {event: t_hub}, first occurrence wins (a chaos
+    duplicate's re-send restamps are reported via its own copy)."""
+    out: Dict[str, float] = {}
+    for node, event, t in rec.get("hops", ()):
+        out.setdefault(event, _hub_t(offsets, node, float(t)))
+    return out
+
+
+def _span(a: Optional[float], b: Optional[float]) -> Optional[float]:
+    if a is None or b is None:
+        return None
+    return b - a
+
+
+def build_rounds(bundle: dict) -> List[dict]:
+    """Per-round critical-path breakdown (see module doc)."""
+    offsets = bundle["offsets"]
+    # primary copies only for the critical path; duplicates kept for the
+    # chaos section of the report
+    syncs: Dict[int, Dict[int, dict]] = {}    # round -> client node -> rec
+    uploads: Dict[int, Dict[int, dict]] = {}  # round -> origin node -> rec
+    for rec in bundle["hops"]:
+        rnd = rec.get("round")
+        if rnd is None or rec.get("copy", 0):
+            continue
+        if rec.get("msg_type") in SYNC_TYPES:
+            syncs.setdefault(rnd, {})[rec.get("node")] = rec
+        elif rec.get("msg_type") == UPLOAD_TYPE:
+            uploads.setdefault(rnd, {}).setdefault(rec.get("org"), rec)
+    out = []
+    for rc in bundle["rounds"]:
+        rnd = rc.get("round")
+        ups = uploads.get(rnd, {})
+        sys_ = syncs.get(rnd, {})
+        row = {
+            "round": rnd,
+            "wall_s": _span(rc.get("t_open_m"), rc.get("t_close_m")),
+            "participants": rc.get("participants"),
+            "close": rc.get("time_agg"),
+        }
+        if ups:
+            # the round closed when its LAST needed upload finished
+            # folding: that chain is the measured critical path
+            def _done_t(rec):
+                h = _hop_map(rec, offsets)
+                return h.get("done", h.get("recv", float("-inf")))
+
+            crit_org = max(ups, key=lambda o: _done_t(ups[o]))
+            up = _hop_map(ups[crit_org], offsets)
+            raw_up_t0 = _ctx_t0(ups[crit_org])
+            up_t0 = (_hub_t(offsets, crit_org, float(raw_up_t0))
+                     if raw_up_t0 is not None else None)
+            sy_rec = sys_.get(crit_org)
+            sy = _hop_map(sy_rec, offsets) if sy_rec else {}
+            raw_sy_t0 = _ctx_t0(sy_rec) if sy_rec else None
+            sy_t0 = (_hub_t(offsets, 0, float(raw_sy_t0))
+                     if raw_sy_t0 is not None else None)
+            row["critical_client"] = crit_org
+            row["serialize"] = _span(sy_t0, sy.get("send"))
+            row["bcast_queue"] = _span(sy.get("hub_in"), sy.get("hub_out"))
+            row["bcast_deliver"] = _span(sy.get("hub_out"), sy.get("recv"))
+            # train = sync arrival -> upload-send entry on the client
+            # (the upload ctx's t0 is stamped at send ENTRY, after the
+            # local update ran inside the sync handler)
+            row["client_train"] = _span(sy.get("recv"), up_t0)
+            row["upload_serialize"] = _span(up_t0, up.get("send"))
+            row["upload_wire"] = _span(up.get("send"), up.get("hub_in"))
+            row["upload_queue"] = _span(up.get("hub_in"), up.get("hub_out"))
+            row["upload_deliver"] = _span(up.get("hub_out"), up.get("recv"))
+            # the Kth upload's handler RUNS the round close (and the
+            # next round's broadcast) before its 'done' stamp, so the
+            # critical fold anchors on t_close_m instead: recv ->
+            # close-stamp minus the separately-measured normalize
+            t_close = (_hub_t(offsets, 0, rc["t_close_m"])
+                       if rc.get("t_close_m") is not None else None)
+            fold_close = _span(up.get("recv"), t_close)
+            row["decode_fold"] = (
+                fold_close - (rc.get("time_agg") or 0.0)
+                if fold_close is not None else
+                _span(up.get("recv"), up.get("done")))
+            # cohort-wide spread (evidence for contention vs queue wait)
+            queues = [_span(h.get("hub_in"), h.get("hub_out"))
+                      for h in (_hop_map(r, offsets) for r in ups.values())]
+            queues = [q for q in queues if q is not None]
+            folds = [_span(h.get("recv"), h.get("done"))
+                     for h in (_hop_map(r, offsets) for r in ups.values())]
+            folds = [q for q in folds if q is not None]
+            row["upload_queue_max"] = max(queues) if queues else None
+            row["fold_sum"] = sum(folds) if folds else None
+            bq = [_span(h.get("hub_in"), h.get("hub_out"))
+                  for h in (_hop_map(r, offsets) for r in sys_.values())]
+            bq = [q for q in bq if q is not None]
+            row["bcast_queue_max"] = max(bq) if bq else None
+            accounted = sum(row.get(p) or 0.0 for p in PHASES)
+            row["accounted_s"] = accounted
+            row["other_s"] = (row["wall_s"] - accounted
+                              if row["wall_s"] is not None else None)
+        out.append(row)
+    return out
+
+
+def _ctx_t0(rec: dict) -> Optional[float]:
+    # trace_hop events carry hops but not t0 directly; t0 rides the
+    # serialized ctx — emitted as its own field when present
+    return rec.get("t0")
+
+
+def percentile(values, q):
+    """Nearest-rank percentile over the non-None samples — the SAME
+    estimator as ``tools/trace_summary.percentile``, pinned because
+    ``fed_trace_run`` mixes both into one artifact (phase p50s from
+    here, round-wall p50s from trace_summary): with a handful of
+    samples, two estimators pick different ranks."""
+    vals = sorted(v for v in values if v is not None)
+    if not vals:
+        return None
+    idx = min(len(vals) - 1, max(0, int(math.ceil(q * len(vals))) - 1))
+    return vals[idx]
+
+
+def summarize(rows: List[dict]) -> dict:
+    """Aggregate p50 per phase over rounds + share of round wall."""
+    p50 = {p: percentile([r.get(p) for r in rows], 0.5) for p in PHASES}
+    p50["other"] = percentile([r.get("other_s") for r in rows], 0.5)
+    wall = percentile([r.get("wall_s") for r in rows], 0.5)
+    shares = {}
+    if wall:
+        for k, v in p50.items():
+            if v is not None:
+                shares[k] = round(v / wall, 4)
+    return {"p50_round_wall_s": wall, "p50_phase_s": p50,
+            "phase_share_of_wall": shares,
+            "rounds": len(rows)}
+
+
+def chaos_copies(bundle: dict) -> List[dict]:
+    """Duplicate deliveries (chaos): every copy>0 chain, verbatim —
+    each has its own hop stamps by construction."""
+    return [
+        {"seq": r.get("seq"), "copy": r.get("copy"), "org": r.get("org"),
+         "round": r.get("round"), "msg_type": r.get("msg_type"),
+         "hops": r.get("hops")}
+        for r in bundle["hops"] if r.get("copy", 0)
+    ]
+
+
+# --- Chrome trace-event export ----------------------------------------------
+
+def _pid(node) -> int:
+    # hub -> 0, server (node 0) -> 1, client node n -> n + 1
+    return 0 if node == HUB else int(node) + 1
+
+
+def to_perfetto(bundle: dict, rows: List[dict]) -> dict:
+    """Chrome trace-event JSON: one process track per participant,
+    slices for every measured span (hub-clock microseconds)."""
+    offsets = bundle["offsets"]
+    events: List[dict] = []
+    names = {0: "hub", 1: "server (node 0)"}
+    all_t: List[float] = []
+    for rec in bundle["hops"]:
+        for node, _, t in rec.get("hops", ()):
+            all_t.append(_hub_t(offsets, node, float(t)))
+            if _pid(node) not in names:
+                names[_pid(node)] = f"client node {node}"
+    for rc in bundle["rounds"]:
+        if rc.get("t_open_m") is not None:
+            all_t.append(_hub_t(offsets, 0, rc["t_open_m"]))
+    if not all_t:
+        raise SystemExit("no trace_hop stamps found (tracing off?)")
+    t_base = min(all_t)
+
+    def us(t_hub: float) -> float:
+        return round((t_hub - t_base) * 1e6, 1)
+
+    for pid, name in sorted(names.items()):
+        events.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": {"name": name}})
+
+    def slice_(pid, name, t0, t1, **args):
+        if t0 is None or t1 is None or t1 < t0:
+            return
+        events.append({"ph": "X", "pid": pid, "tid": 0, "name": name,
+                       "ts": us(t0), "dur": round((t1 - t0) * 1e6, 1),
+                       "args": args})
+
+    for rec in bundle["hops"]:
+        h = _hop_map(rec, offsets)
+        mt, rnd = rec.get("msg_type"), rec.get("round")
+        tag = f"{mt} r{rnd}" + (f" c{rec['copy']}" if rec.get("copy") else "")
+        org, node = rec.get("org"), rec.get("node")
+        t0 = rec.get("t0")
+        if t0 is not None and "send" in h:
+            slice_(_pid(org), f"serialize {tag}",
+                   _hub_t(offsets, org, float(t0)), h["send"], to=node)
+        slice_(0, f"hub queue {tag} -> {node}",
+               h.get("hub_in"), h.get("hub_out"), receiver=node)
+        slice_(_pid(node), f"handle {tag}", h.get("recv"), h.get("done"),
+               sender=org)
+    for rc in bundle["rounds"]:
+        if rc.get("t_open_m") is None:
+            continue
+        slice_(1, f"round {rc.get('round')}",
+               _hub_t(offsets, 0, rc["t_open_m"]),
+               _hub_t(offsets, 0, rc["t_close_m"]),
+               participants=rc.get("participants"))
+    for hs in bundle["hub_stats"]:
+        t = hs.get("t_m")
+        if t is None:
+            continue
+        for nid, frames in (hs.get("queue_frames") or {}).items():
+            events.append({"ph": "C", "pid": 0,
+                           "name": f"send queue frames node {nid}",
+                           "ts": us(float(t)),
+                           "args": {"frames": frames}})
+        events.append({"ph": "C", "pid": 0, "name": "backpressure drops",
+                       "ts": us(float(t)),
+                       "args": {"drops": hs.get("backpressure_drops", 0)}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# --- CLI --------------------------------------------------------------------
+
+def _fmt_ms(v) -> str:
+    return f"{v * 1e3:8.2f}" if v is not None else "       -"
+
+
+def render(rows: List[dict], summary: dict, copies: List[dict]) -> str:
+    lines = ["== per-round critical path (ms, hub clock) =="]
+    hdr = ["round", "wall"] + PHASES + ["other", "crit_client"]
+    lines.append(" ".join(f"{h:>12}" for h in hdr))
+    for r in rows:
+        vals = [f"{r['round']:>12}", _fmt_ms(r.get("wall_s")).rjust(12)]
+        vals += [_fmt_ms(r.get(p)).rjust(12) for p in PHASES]
+        vals += [_fmt_ms(r.get("other_s")).rjust(12),
+                 str(r.get("critical_client", "-")).rjust(12)]
+        lines.append(" ".join(vals))
+    lines.append("")
+    lines.append("== aggregate (p50 across rounds) ==")
+    wall = summary["p50_round_wall_s"]
+    lines.append(f"p50 round wall: {_fmt_ms(wall).strip()} ms")
+    for p in PHASES + ["other"]:
+        v = summary["p50_phase_s"].get(p)
+        share = summary["phase_share_of_wall"].get(p)
+        pct = f"{share * 100:5.1f}%" if share is not None else "     -"
+        lines.append(f"  {p:>16}: {_fmt_ms(v).strip():>9} ms  {pct}")
+    if copies:
+        lines.append("")
+        lines.append(f"== chaos duplicate copies: {len(copies)} "
+                     "(distinct hop stamps per copy) ==")
+        for c in copies[:10]:
+            lines.append(f"  seq={c['seq']} copy={c['copy']} "
+                         f"{c['msg_type']} r{c['round']} org={c['org']}")
+    return "\n".join(lines)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("run_dir")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the merged breakdown as JSON")
+    ap.add_argument("--perfetto", default="",
+                    help="write Chrome trace-event JSON to this path")
+    args = ap.parse_args(argv)
+    bundle = load_run(args.run_dir)
+    rows = build_rounds(bundle)
+    summary = summarize(rows)
+    copies = chaos_copies(bundle)
+    if args.perfetto:
+        trace = to_perfetto(bundle, rows)
+        with open(args.perfetto, "w") as fh:
+            json.dump(trace, fh)
+        print(f"perfetto trace: {args.perfetto} "
+              f"({len(trace['traceEvents'])} events)", file=sys.stderr)
+    if args.json:
+        print(json.dumps({"rounds": rows, "summary": summary,
+                          "clock_offsets_s": {
+                              str(k): v
+                              for k, v in bundle["offsets"].items()},
+                          "clock_resync_nodes": bundle.get(
+                              "clock_resync_nodes", []),
+                          "duplicate_copies": copies}, indent=1,
+                         default=float))
+    else:
+        print(render(rows, summary, copies))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,354 @@
+#!/usr/bin/env python
+"""Distributed-tracing evidence run → ``FEDTRACE_r08.json``.
+
+Answers the question PR 5 left open: at 32 clients on this box the hub
+multicast path wins 32x on bytes but p50 round wall is ~12% WORSE than
+legacy — WHERE does the time go?  Per-process telemetry could not say;
+the per-hop trace context + clock-aligned merger (``fed_timeline``) can.
+
+Arms (all on THIS commit, FEDLAT_r07 configuration: ≥1 MB model =
+``logistic_regression(--input-dim 131072, 2)``, ``--train-samples 16``
+comm-dominant regime, fast hotpath, codec off):
+
+1. ``off_16`` / ``on_16`` — 16 clients, tracing off vs on: the tracing
+   OVERHEAD A/B.  Threshold (pre-declared): p50 round wall with tracing
+   on ≤ 1.03x off (the header-only restamp must be ~free).  On this
+   2-core box a 16-client federation is ~9x oversubscribed and single
+   runs vary by far more than 3%, so the A/B is run as ``--reps``
+   interleaved repetitions in ABBA order (off,on,on,off — cancels
+   linear drift: page-cache warmup, governor state), with a process
+   barrier + settle sleep between runs (a leaked client from run N
+   polluting run N+1 is exactly the failure mode that produced a
+   bogus 2x "overhead" on the first attempt — the mechanism itself
+   bisects to ~0 at small scale).  Both arms write ``--run-dir``
+   metrics files; the ONLY flipped variable is ``FEDML_TPU_TRACE``.
+   The verdict compares the MEDIAN of per-rep p50s (the box's round
+   wall is bistable under 16-way concurrent 1 MB uploads — whole runs
+   land in a ~70 ms-slower scheduling mode regardless of arm; a
+   median over reps is robust to one such run, a single run is not);
+   the pooled-delta p50s ride along for transparency.  A quiet-box
+   micro benchmark of the mechanism itself (one sender → hub → one
+   receiver at the SAME model size, per-message e2e latency off vs
+   on) is embedded in the artifact: the per-message cost is the
+   number the scheduling noise cannot fake.
+2. ``off_32`` / ``on_32`` — 32 clients: ``on_32``'s merged timeline is
+   the ATTRIBUTION of the 32-client regression — the per-phase p50
+   breakdown (hub queue wait / sender-pool drain / client compute /
+   upload fold) compared against ``on_16``'s, phases that grow
+   superlinearly named in the verdict.  ``off_32`` pins this session's
+   untraced 32-client p50 alongside.
+
+Both measurements read the same series FEDLAT_r07 used (server
+``round_log`` close-stamp t-deltas), so the numbers are directly
+comparable.  The 32-client Perfetto trace and the merged breakdown are
+written next to the artifact (``tools/logs/``).
+
+Usage: python tools/fed_trace_run.py [--clients 16] [--rounds 9]
+       [--input-dim 131072] [--out FEDTRACE_r08.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools import fed_timeline  # noqa: E402
+from tools.trace_summary import percentile  # noqa: E402
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--clients", type=int, default=16)
+    p.add_argument("--clients-big", type=int, default=32)
+    p.add_argument("--rounds", type=int, default=9)
+    p.add_argument("--input-dim", type=int, default=131072)
+    p.add_argument("--train-samples", type=int, default=16)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--round-timeout", type=float, default=180.0)
+    p.add_argument("--reps", type=int, default=2,
+                   help="interleaved repetitions per 16-client A/B arm")
+    p.add_argument("--skip-32", action="store_true",
+                   help="skip the 32-client arms (slow-box escape hatch)")
+    p.add_argument("--out", default="FEDTRACE_r08.json")
+    args = p.parse_args()
+
+    import numpy as np
+
+    from fedml_tpu.experiments.distributed_fedavg import launch
+
+    env = dict(os.environ)
+    env["FEDML_TPU_FORCE_CPU"] = "1"
+    env["XLA_FLAGS"] = ""
+    log_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), "logs")
+    os.makedirs(log_dir, exist_ok=True)
+
+    def micro_mechanism(nfloat, n=60):
+        """Quiet-box per-message mechanism cost at the A/B's model
+        size: one sender → hub → one receiver in THIS process, no
+        oversubscription.  Median e2e (send entry → handler entry) and
+        send() latency per arm — the overhead floor the federation
+        numbers are judged against."""
+        import numpy as np
+
+        from fedml_tpu.comm.backend import NodeManager
+        from fedml_tpu.comm.message import Message, tree_to_wire
+        from fedml_tpu.comm.tcp import TcpBackend, TcpHub
+        from fedml_tpu.obs import trace_ctx
+
+        def one(trace):
+            trace_ctx.set_enabled(trace)
+            hub = TcpHub()
+            got = []
+
+            class Mgr(NodeManager):
+                def register_message_receive_handlers(self):
+                    self.register_message_receive_handler(
+                        "T", lambda m: got.append(time.perf_counter()))
+
+            recv = TcpBackend(1, hub.host, hub.port)
+            Mgr(recv)
+            recv.run_in_thread()
+            send = TcpBackend(2, hub.host, hub.port)
+            send.await_peers([1])
+            w = np.zeros(nfloat, dtype=np.float32)
+            e2e, snd = [], []
+            try:
+                for i in range(n):
+                    m = Message("T", 2, 1)
+                    m.add_params("model", tree_to_wire({"w": w}))
+                    m.add_params("round_idx", i)
+                    t0 = time.perf_counter()
+                    send.send_message(m)
+                    t1 = time.perf_counter()
+                    while len(got) <= i:
+                        time.sleep(0.0002)
+                    e2e.append(got[i] - t0)
+                    snd.append(t1 - t0)
+            finally:
+                send.stop()
+                recv.stop()
+                hub.stop()
+                trace_ctx.set_enabled(None)
+            return {"e2e_p50_s": percentile(e2e, 0.5),
+                    "send_p50_s": percentile(snd, 0.5),
+                    "msgs": n}
+        off, on = one(False), one(True)
+        return {
+            "model_floats": nfloat,
+            "off": off, "on": on,
+            "per_msg_overhead_s": round(
+                on["e2e_p50_s"] - off["e2e_p50_s"], 6),
+        }
+
+    def barrier(settle: float = 3.0):
+        """No federation process from a previous run may overlap the
+        next measurement (the contamination that sank the first A/B
+        attempt: a dry run's 18 leaked processes time-sharing the box
+        with the 'on' arm).  Wait for every distributed_fedavg child to
+        exit, then give the scheduler/page cache a beat to settle."""
+        deadline = time.time() + 60.0
+        while time.time() < deadline:
+            out = subprocess.run(
+                ["pgrep", "-f", "fedml_tpu.experiments.distributed_fedavg"],
+                capture_output=True, text=True,
+            ).stdout.strip()
+            if not out:
+                break
+            time.sleep(1.0)
+        else:
+            print(f"WARNING: stray federation processes survive the "
+                  f"barrier: {out!r}", file=sys.stderr)
+        time.sleep(settle)
+
+    def run_one(tag, clients, trace):
+        # BOTH arms get a run_dir (per-process metrics emission is part
+        # of the baseline): the only variable the A/B flips is
+        # FEDML_TPU_TRACE itself
+        run_dir = f"/tmp/fedtrace_{tag}"
+        shutil.rmtree(run_dir, ignore_errors=True)
+        barrier()
+        info = {}
+        t0 = time.time()
+        rc = launch(
+            num_clients=clients, rounds=args.rounds, seed=args.seed,
+            batch_size=args.batch_size, out_path=f"/tmp/fedtrace_{tag}.npz",
+            round_timeout=args.round_timeout,
+            codec="none", wire=2, input_dim=args.input_dim,
+            hotpath="fast", train_samples=args.train_samples,
+            run_dir=run_dir, trace=trace,
+            info=info, env=env, server_env=env,
+            timeout=600.0 + args.rounds * args.round_timeout,
+        )
+        if rc != 0:
+            raise SystemExit(f"{tag}: server subprocess failed rc={rc}")
+        wall = round(time.time() - t0, 1)
+        z = np.load(f"/tmp/fedtrace_{tag}.npz")
+        round_log = json.loads(str(z["round_log"]))
+        stamps = [r["t"] for r in round_log
+                  if isinstance(r.get("t"), (int, float))]
+        deltas = [round(b - a, 4) for a, b in zip(stamps, stamps[1:])]
+        return {
+            "clients": clients,
+            "trace": trace,
+            "rounds": info.get("rounds"),
+            "wall_s": wall,
+            "run_dir": run_dir,
+            "round_wall_s": {
+                "samples": deltas,
+                "p50": percentile(deltas, 0.50),
+                "p95": percentile(deltas, 0.95),
+            },
+        }
+
+    def pooled(reps):
+        samples = [s for r in reps for s in r["round_wall_s"]["samples"]]
+        return {
+            "clients": reps[0]["clients"],
+            "trace": reps[0]["trace"],
+            "reps": len(reps),
+            "rounds": reps[0]["rounds"],
+            "run_dir": reps[-1]["run_dir"],
+            "per_rep_p50": [r["round_wall_s"]["p50"] for r in reps],
+            "per_rep_wall_s": [r["wall_s"] for r in reps],
+            "round_wall_s": {
+                "samples": samples,
+                "p50": percentile(samples, 0.50),
+                "p95": percentile(samples, 0.95),
+            },
+        }
+
+    def breakdown(run_dir, perfetto_out=None):
+        bundle = fed_timeline.load_run(run_dir)
+        rows = fed_timeline.build_rounds(bundle)
+        summary = fed_timeline.summarize(rows)
+        if perfetto_out:
+            trace = fed_timeline.to_perfetto(bundle, rows)
+            with open(perfetto_out, "w") as fh:
+                json.dump(trace, fh)
+        return rows, summary
+
+    # ABBA interleave: off,on,on,off,off,on,... — each adjacent pair
+    # shares its box state, so drift (cache warmth, governor, memory
+    # pressure) cancels instead of loading onto one arm
+    order = []
+    for i in range(args.reps):
+        order += [(False, i), (True, i)] if i % 2 == 0 \
+            else [(True, i), (False, i)]
+    reps = {False: [], True: []}
+    for trace, i in order:
+        tag = f"{'on' if trace else 'off'}_16_r{i}"
+        reps[trace].append(run_one(tag, args.clients, trace=trace))
+    arms = {}
+    arms["off_16"] = pooled(reps[False])
+    arms["on_16"] = pooled(reps[True])
+    # breakdown from the MEDIAN-p50 traced rep (not rep 0 — which may
+    # be the one run the box's slow scheduling mode caught)
+    med16 = percentile(arms["on_16"]["per_rep_p50"], 0.5)
+    rep16 = min(reps[True],
+                key=lambda r: abs(r["round_wall_s"]["p50"] - med16))
+    rows16, sum16 = breakdown(rep16["run_dir"])
+    if not args.skip_32:
+        arms["off_32"] = run_one("off_32", args.clients_big, trace=False)
+        arms["on_32"] = run_one("on_32", args.clients_big, trace=True)
+        pf_path = os.path.join(log_dir, "fedtrace_32_perfetto.json")
+        rows32, sum32 = breakdown(arms["on_32"]["run_dir"], pf_path)
+        with open(os.path.join(log_dir, "fedtrace_32_breakdown.json"),
+                  "w") as fh:
+            json.dump({"rounds": rows32, "summary": sum32}, fh, indent=1,
+                      default=float)
+    else:
+        rows32 = sum32 = pf_path = None
+
+    micro = micro_mechanism(args.input_dim * 2 + 2)
+
+    # verdict estimator: median of per-rep p50s (robust to one run
+    # caught in the box's slow scheduling mode — see module doc)
+    p50_off = percentile(arms["off_16"]["per_rep_p50"], 0.5)
+    p50_on = percentile(arms["on_16"]["per_rep_p50"], 0.5)
+    overhead = (p50_on / p50_off - 1.0) if p50_off else None
+
+    attribution = None
+    if sum32 is not None:
+        # phases that grow when clients double (same per-client bytes,
+        # same compute): the named attribution of the 32-client wall
+        growth = {}
+        for ph in fed_timeline.PHASES + ["other"]:
+            a = sum16["p50_phase_s"].get(ph)
+            b = sum32["p50_phase_s"].get(ph)
+            if a is not None and b is not None:
+                growth[ph] = {
+                    "p50_16_s": round(a, 6), "p50_32_s": round(b, 6),
+                    "delta_s": round(b - a, 6),
+                    "share_of_32_wall": sum32["phase_share_of_wall"].get(ph),
+                }
+        # materiality floor: a phase only counts as "dominant growth"
+        # when it gains ≥5 ms — sub-ms jitter must not share a verdict
+        # line with a 400 ms queue blowup
+        ranked = sorted(((k, v) for k, v in growth.items()
+                         if v["delta_s"] >= 0.005),
+                        key=lambda kv: -(kv[1]["delta_s"]))
+        attribution = {
+            "p50_round_wall_16_s": sum16["p50_round_wall_s"],
+            "p50_round_wall_32_s": sum32["p50_round_wall_s"],
+            "per_phase": growth,
+            "dominant_growth_phases": [k for k, _ in ranked[:3]],
+        }
+
+    artifact = {
+        "experiment": (
+            f"federation-wide distributed tracing on the real TCP hub "
+            f"(FEDLAT_r07 config: logistic_regression({args.input_dim}, 2) "
+            f"= {(args.input_dim * 2 + 2) * 4 / 1e6:.2f} MB fp32 model, "
+            f"--train-samples {args.train_samples} comm-dominant, fast "
+            f"hotpath, codec off, {args.rounds} rounds).  A/B arms flip "
+            f"ONLY FEDML_TPU_TRACE on the same commit ({args.reps} "
+            f"interleaved ABBA reps per arm, process barrier + settle "
+            f"between runs, verdict = median of per-rep p50s); deltas are "
+            f"the same server round_log t-deltas FEDLAT_r07 reports."
+        ),
+        "thresholds_pre_declared": {
+            "trace_overhead_p50_max": 0.03,
+        },
+        "arms": arms,
+        "mechanism_micro": micro,
+        "breakdown_16": {"summary": sum16},
+        "breakdown_32": ({"summary": sum32,
+                          "perfetto": pf_path,
+                          "rows": "tools/logs/fedtrace_32_breakdown.json"}
+                         if sum32 is not None else None),
+        "attribution_32_client_regression": attribution,
+        "verdict": {
+            "trace_overhead_p50": {
+                "estimator": "median of per-rep p50s",
+                "off": p50_off, "on": p50_on,
+                "overhead": round(overhead, 4) if overhead is not None
+                else None,
+                "per_msg_mechanism_overhead_s":
+                    micro["per_msg_overhead_s"],
+                "ok": bool(overhead is not None and overhead <= 0.03),
+            },
+        },
+    }
+    with open(args.out, "w") as f:
+        json.dump(artifact, f, indent=1, default=float)
+    print(json.dumps({"out": args.out,
+                      "p50_off_16": p50_off, "p50_on_16": p50_on,
+                      "overhead": artifact["verdict"]
+                      ["trace_overhead_p50"]["overhead"],
+                      "dominant_growth_phases":
+                      attribution and
+                      attribution["dominant_growth_phases"]}))
+    if not artifact["verdict"]["trace_overhead_p50"]["ok"]:
+        raise SystemExit("fed trace overhead verdict FAILED")
+
+
+if __name__ == "__main__":
+    main()

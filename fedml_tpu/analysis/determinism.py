@@ -1,0 +1,128 @@
+"""determinism — seedless randomness / wall-clock bans in round-path code.
+
+The reproducibility contract (PR 4/5: byte-identical same-seed upload
+digests, deterministic chaos schedules) only holds if every random draw
+in the round path flows from an explicit seed — ``np.random.RandomState
+(seed)``, ``random.Random(seed_string)``, or a jax ``fold_in``-derived
+stream — and nothing reads the wall clock where behavior depends on it.
+This rule flags, in round-path modules only:
+
+- module-level stdlib ``random.*`` draws (the shared, seedless global
+  RNG: ``random.random()``, ``randint``, ``choice``, ...);
+- ``random.Random()`` constructed WITHOUT a seed;
+- ``np.random.*`` draws and ``np.random.seed`` (global-state RNG);
+  seeded constructors (``RandomState(seed)``, ``default_rng(seed)``)
+  pass, the same constructors with no argument do not;
+- ``time.time()`` / ``time.time_ns()`` — wall-clock timestamps belong
+  to the obs/ layer (monotonic ``perf_counter`` spans are fine and are
+  not flagged).
+
+Round-path scope: ``comm/``, ``algorithms/``, ``core/``, ``compress/``,
+``faults/``, ``parallel/``, ``ops/``.  ``obs/`` (whose whole job is
+timestamps), ``experiments/`` (driver wall-time reporting), ``data/``
+and ``models/`` are out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Sequence
+
+from fedml_tpu.analysis.base import (
+    Finding,
+    SourceFile,
+    module_aliases,
+    resolve_call_target,
+)
+
+RULE = "determinism"
+
+ROUND_PATH_PREFIXES = (
+    "fedml_tpu/comm/",
+    "fedml_tpu/algorithms/",
+    "fedml_tpu/core/",
+    "fedml_tpu/compress/",
+    "fedml_tpu/faults/",
+    "fedml_tpu/parallel/",
+    "fedml_tpu/ops/",
+)
+
+# module-level functions on stdlib random's hidden global Random()
+SEEDLESS_RANDOM_FNS = {
+    "random", "randint", "randrange", "randbytes", "choice", "choices",
+    "shuffle", "sample", "uniform", "triangular", "betavariate",
+    "expovariate", "gammavariate", "gauss", "lognormvariate",
+    "normalvariate", "vonmisesvariate", "paretovariate",
+    "weibullvariate", "getrandbits",
+}
+
+# np.random constructors that are deterministic WHEN GIVEN a seed
+NP_SEEDED_CONSTRUCTORS = {
+    "RandomState", "default_rng", "Generator", "SeedSequence",
+    "PCG64", "Philox", "MT19937", "SFC64", "BitGenerator",
+}
+
+WALL_CLOCK_FNS = {"time.time", "time.time_ns"}
+
+
+def in_scope(rel: str) -> bool:
+    return rel.startswith(ROUND_PATH_PREFIXES)
+
+
+def check(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if not in_scope(sf.rel):
+            continue
+        aliases = module_aliases(sf.tree)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = resolve_call_target(node.func, aliases)
+            if target is None:
+                continue
+            msg = _classify(target, node)
+            if msg is not None:
+                findings.append(
+                    Finding(RULE, sf.rel, node.lineno, node.col_offset, msg)
+                )
+    return findings
+
+
+def _classify(target: str, call: ast.Call):
+    """None when the call is fine; otherwise the finding message."""
+    head, _, tail = target.partition(".")
+    if head == "random":
+        if tail in SEEDLESS_RANDOM_FNS:
+            return (
+                f"seedless stdlib RNG 'random.{tail}()' in a round-path "
+                "module — draw from an explicitly seeded "
+                "random.Random(...) or a fold_in-derived stream"
+            )
+        if tail == "Random" and not call.args and not call.keywords:
+            return (
+                "'random.Random()' without a seed — pass an explicit "
+                "seed (a stable string seeds via sha512, cross-process)"
+            )
+        return None
+    if target.startswith("numpy.random.") or head == "numpy.random":
+        fn = target.split(".")[-1]
+        if fn in NP_SEEDED_CONSTRUCTORS:
+            if call.args or call.keywords:
+                return None  # RandomState(seed), default_rng(seed): seeded
+            return (
+                f"'np.random.{fn}()' without a seed in a round-path "
+                "module — pass the run seed (or a fold_in-derived value)"
+            )
+        return (
+            f"seedless/global-state numpy RNG 'np.random.{fn}(...)' in a "
+            "round-path module — use np.random.RandomState(seed) or a "
+            "fold_in-derived stream"
+        )
+    if target in WALL_CLOCK_FNS:
+        return (
+            f"wall-clock '{target}()' in a round-path module — "
+            "timestamps belong to obs/ (use time.perf_counter() for "
+            "spans; wall stamps only via the telemetry layer)"
+        )
+    return None

@@ -1,0 +1,80 @@
+"""wire-schema — reserved frame-header keys are defined exactly once.
+
+The wire format's reserved keys (``__hub__`` control frames,
+``__binlen__`` payload-length announcements, ``__ndbuf__`` buffer
+references, ``__wiretree__`` pytree envelopes, ``__ndarray__`` b64
+leaves, ``__trace__`` hop contexts) are protocol: every reader and
+writer must agree on the exact byte string.  A literal copy in a second
+module is the drift class behind silent wire-format skew — rename the
+canonical one (PR 6's ``hub.mcast_frames`` rename was exactly this
+class, in metric space) and the copy keeps "working" while routing or
+tracing quietly breaks.
+
+Rule: each reserved key may appear as a string literal ONLY in its
+defining module, and there only as the right-hand side of its canonical
+constant assignment.  Every other use must reference the constant
+(``from fedml_tpu.comm.message import HUB_KEY``, ...).
+
+Docstrings and comments are unaffected (the checker compares whole
+string-literal VALUES, and comments never reach the AST).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Sequence, Tuple
+
+from fedml_tpu.analysis.base import Finding, SourceFile
+
+RULE = "wire-schema"
+
+# literal -> (canonical constant, defining module rel path)
+RESERVED_KEYS: Dict[str, Tuple[str, str]] = {
+    "__hub__": ("HUB_KEY", "fedml_tpu/comm/message.py"),
+    "__binlen__": ("FRAME_BINLEN_KEY", "fedml_tpu/comm/message.py"),
+    "__ndbuf__": ("FRAME_NDBUF_KEY", "fedml_tpu/comm/message.py"),
+    "__wiretree__": ("WIRETREE_KEY", "fedml_tpu/comm/message.py"),
+    "__ndarray__": ("NDARRAY_KEY", "fedml_tpu/comm/message.py"),
+    "__trace__": ("TRACE_KEY", "fedml_tpu/obs/trace_ctx.py"),
+}
+
+
+def _definition_lines(sf: SourceFile) -> Dict[str, int]:
+    """lineno of each module-level ``CONST = "literal"`` assignment."""
+    out: Dict[str, int] = {}
+    for node in sf.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.Constant) \
+                and isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.lineno
+    return out
+
+
+def check(files: Sequence[SourceFile]) -> List[Finding]:
+    findings: List[Finding] = []
+    for sf in files:
+        if sf.rel.startswith("fedml_tpu/analysis/"):
+            # the checker's own registry (this module) names the keys
+            # by necessity; analysis/ is stdlib-only and cannot import
+            # the numpy-backed comm.message constants
+            continue
+        defs = _definition_lines(sf)
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and node.value in RESERVED_KEYS):
+                continue
+            const, home = RESERVED_KEYS[node.value]
+            if sf.rel == home and defs.get(const) == node.lineno:
+                continue  # the one canonical definition
+            where = (f"defined once in {home}"
+                     if sf.rel != home else
+                     f"this module's canonical '{const} = ...' assignment")
+            findings.append(Finding(
+                RULE, sf.rel, node.lineno, node.col_offset,
+                f"reserved wire key '{node.value}' as a string literal — "
+                f"use the constant {const} ({where}); literal copies "
+                "drift when the protocol evolves",
+            ))
+    return findings

@@ -34,6 +34,17 @@ from typing import Any, Dict, List
 
 import numpy as np
 
+# --- reserved wire-format keys ---------------------------------------------
+# Protocol vocabulary, defined ONCE (the fedlint wire-schema rule bans
+# literal copies elsewhere — a second copy keeps "working" while the
+# canonical one evolves).  ``TRACE_KEY`` (= "__trace__") lives in
+# ``obs/trace_ctx.py`` with the same contract.
+HUB_KEY = "__hub__"  # hub control frames (register/ack/ping/mcast/stop)
+FRAME_BINLEN_KEY = "__binlen__"  # header: raw payload bytes that follow
+FRAME_NDBUF_KEY = "__ndbuf__"  # header entry: [offset, nbytes] buffer ref
+WIRETREE_KEY = "__wiretree__"  # wire pytree envelope (version tag)
+NDARRAY_KEY = "__ndarray__"  # v1 b64 array leaf
+
 # --- reserved keys ---------------------------------------------------------
 MSG_ARG_KEY_TYPE = "msg_type"
 MSG_ARG_KEY_SENDER = "sender"
@@ -169,8 +180,6 @@ class Message:
 
 # --- pytree <-> wire codecs -------------------------------------------------
 
-FRAME_BINLEN_KEY = "__binlen__"
-
 
 def tree_to_wire(tree: Any, *, version: int = 2, codec=None, key=None,
                  delta: bool = False) -> Any:
@@ -190,7 +199,7 @@ def tree_to_wire(tree: Any, *, version: int = 2, codec=None, key=None,
         from fedml_tpu.compress import wire_encode_tree
 
         return {
-            "__wiretree__": 2,
+            WIRETREE_KEY: 2,
             "codec": codec.name,
             "delta": bool(delta),
             "leaves": wire_encode_tree(codec, tree, key),
@@ -198,11 +207,11 @@ def tree_to_wire(tree: Any, *, version: int = 2, codec=None, key=None,
     leaves, _ = jax.tree_util.tree_flatten(tree)
     if version == 1:
         return {
-            "__wiretree__": 1,
+            WIRETREE_KEY: 1,
             "leaves": [_encode_array(np.asarray(l)) for l in leaves],
         }
     return {
-        "__wiretree__": 2,
+        WIRETREE_KEY: 2,
         "leaves": [np.ascontiguousarray(np.asarray(l)) for l in leaves],
     }
 
@@ -234,7 +243,7 @@ def tree_from_wire(obj: Any, like: Any) -> Any:
 
         entries = [
             {**e, "enc": {k: (_decode_array(v)
-                              if isinstance(v, dict) and "__ndarray__" in v
+                              if isinstance(v, dict) and NDARRAY_KEY in v
                               else np.asarray(v))
                           for k, v in e["enc"].items()}}
             for e in obj["leaves"]
@@ -242,7 +251,7 @@ def tree_from_wire(obj: Any, like: Any) -> Any:
         return wire_decode_tree(get_codec(name), entries, like)
     leaves_like, treedef = jax.tree_util.tree_flatten(like)
     leaves = [
-        _decode_array(e) if isinstance(e, dict) and "__ndarray__" in e
+        _decode_array(e) if isinstance(e, dict) and NDARRAY_KEY in e
         else np.asarray(e)
         for e in obj["leaves"]
     ]
@@ -267,14 +276,14 @@ def _np_dtype(name: str) -> np.dtype:
 
 def _encode_array(a: np.ndarray) -> dict:
     return {
-        "__ndarray__": base64.b64encode(np.ascontiguousarray(a).tobytes()).decode(),
+        NDARRAY_KEY: base64.b64encode(np.ascontiguousarray(a).tobytes()).decode(),
         "dtype": str(a.dtype),
         "shape": list(a.shape),
     }
 
 
 def _decode_array(obj: dict) -> np.ndarray:
-    buf = base64.b64decode(obj["__ndarray__"])
+    buf = base64.b64decode(obj[NDARRAY_KEY])
     return np.frombuffer(buf, dtype=_np_dtype(obj["dtype"])).reshape(obj["shape"])
 
 
@@ -301,7 +310,7 @@ def _extract_buffers(v, bufs: List, offset: List[int]):
         except (TypeError, ValueError, BufferError):
             b = a.tobytes()  # exotic dtypes (ml_dtypes) may refuse cast
         ref = {
-            "__ndbuf__": [offset[0], len(b)],
+            FRAME_NDBUF_KEY: [offset[0], len(b)],
             "dtype": str(a.dtype),
             "shape": list(a.shape),
         }
@@ -319,8 +328,8 @@ def _inject_buffers(v, payload: bytes):
     """Inverse of ``_extract_buffers``: materialize ``__ndbuf__``
     references as (read-only) numpy views into ``payload``."""
     if isinstance(v, dict):
-        if "__ndbuf__" in v:
-            off, n = v["__ndbuf__"]
+        if FRAME_NDBUF_KEY in v:
+            off, n = v[FRAME_NDBUF_KEY]
             return np.frombuffer(
                 payload[off:off + n], dtype=_np_dtype(v["dtype"])
             ).reshape(v["shape"])
@@ -344,9 +353,9 @@ def _decode_value(v):
     """Recursive decode: arrays survive the roundtrip at ANY nesting depth
     (encoding recurses via json.dumps default=, so decoding must too)."""
     if isinstance(v, dict):
-        if "__ndarray__" in v:
+        if NDARRAY_KEY in v:
             return _decode_array(v)
-        if "__wiretree__" in v:
+        if WIRETREE_KEY in v:
             return v  # wire pytree: decoded lazily via tree_from_wire (needs template)
         return {k: _decode_value(x) for k, x in v.items()}
     if isinstance(v, list):

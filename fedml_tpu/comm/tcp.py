@@ -25,23 +25,34 @@ the stale connection is dropped, and other nodes keep flowing.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import logging
 import queue
-import random
 import socket
 import threading
 import time
 from collections import deque
 from typing import Dict, List, Tuple
 
+from fedml_tpu.analysis.locks import assert_held, make_lock
 from fedml_tpu.comm.backend import CommBackend
-from fedml_tpu.comm.message import FRAME_BINLEN_KEY, Message
+from fedml_tpu.comm.message import FRAME_BINLEN_KEY, HUB_KEY, Message
 from fedml_tpu.obs import trace_ctx
 from fedml_tpu.obs.telemetry import get_telemetry
 
-_SENTINEL = {"__hub__": "stop"}
-_ACK = {"__hub__": "ack"}
+_SENTINEL = {HUB_KEY: "stop"}
+_ACK = {HUB_KEY: "ack"}
+
+
+def _retry_jitter(node_id: int, attempt: int) -> float:
+    """Deterministic send-retry jitter in [0, 1): a fold_in-style hash
+    of (node, attempt), so two nodes backing off together still
+    de-synchronize but a chaos-soak re-run reproduces the exact retry
+    timing (the last seedless draw in the round path — everything else
+    flows from explicit seeds)."""
+    digest = hashlib.sha256(f"retry|{node_id}|{attempt}".encode()).digest()
+    return int.from_bytes(digest[:8], "big") / 2.0 ** 64
 
 # Per-socket buffer target: model frames are multi-MB, and the Linux
 # defaults (~208 KiB) force many small send/recv cycles per frame even
@@ -137,6 +148,19 @@ class TcpHub:
     by enqueueing the SAME immutable bytes to every receiver: the
     server→hub broadcast leg carries each sync exactly once."""
 
+    # lock-discipline contract (fedlint): reader threads, the sender
+    # pool, and the accept path all share these — every touch goes
+    # through self._lock (per-conn payload state lives on _Conn and is
+    # protected by the same lock; the single-drainer rule serializes
+    # the socket itself)
+    _GUARDED_BY = {
+        "_conns": "_lock",
+        "dropped_frames": "_lock",
+        "backpressure_drops": "_lock",
+        "mcast_frames": "_lock",
+        "mcast_copies": "_lock",
+    }
+
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  senders: int = 4, max_queue_bytes: int = 256 << 20,
                  max_queue_frames: int = 4096):
@@ -156,7 +180,7 @@ class TcpHub:
         self._max_queue_bytes = max_queue_bytes
         self._max_queue_frames = max_queue_frames
         self._conns: Dict[int, _Conn] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("TcpHub._lock")
         self._ready: "queue.SimpleQueue" = queue.SimpleQueue()
         self._running = True
         self._senders = [
@@ -213,10 +237,10 @@ class TcpHub:
                     frame = json.loads(line)
                 except json.JSONDecodeError:
                     return  # garbled handshake: connection-fatal
-                kind = frame.get("__hub__")
+                kind = frame.get(HUB_KEY)
                 if kind == "ping":
                     conn.sendall((json.dumps({
-                        "__hub__": "pong",
+                        HUB_KEY: "pong",
                         "t0": frame.get("t0"),
                         "th": time.perf_counter(),
                     }) + "\n").encode())
@@ -229,7 +253,7 @@ class TcpHub:
             st = _Conn(conn)
             with self._lock:
                 self._conns[node_id] = st
-            pending = None if frame.get("__hub__") == "ping_done" \
+            pending = None if frame.get(HUB_KEY) == "ping_done" \
                 else (line, frame)
             while True:
                 if pending is not None:
@@ -263,7 +287,7 @@ class TcpHub:
                     payload = f.read(binlen)
                     if len(payload) < binlen:
                         break  # peer died mid-payload: torn frame == EOF
-                if frame.get("__hub__") == "mcast":
+                if frame.get(HUB_KEY) == "mcast":
                     # hub multicast: ``payload`` is ONE complete inner
                     # frame (header line + buffers) shipped once over
                     # the server→hub leg; fan it out by enqueueing the
@@ -305,7 +329,7 @@ class TcpHub:
                         else:
                             self._forward(r, (payload,), msg_type=mt)
                     continue
-                if frame.get("__hub__") == "peers":
+                if frame.get(HUB_KEY) == "peers":
                     # membership introspection: reply to THIS node with
                     # the currently registered ids (startup barrier —
                     # frames to unregistered receivers are dropped, so
@@ -314,11 +338,11 @@ class TcpHub:
                         ids = sorted(self._conns)
                     self._forward(
                         node_id,
-                        ((json.dumps({"__hub__": "peers", "ids": ids})
+                        ((json.dumps({HUB_KEY: "peers", "ids": ids})
                           + "\n").encode(),),
                     )
                     continue
-                if frame.get("__hub__") == "stop":
+                if frame.get(HUB_KEY) == "stop":
                     break
                 receiver = frame.get("receiver")
                 if receiver is not None:
@@ -424,7 +448,7 @@ class TcpHub:
                     with self._lock:
                         if self._conns.get(nid) is st:
                             self._conns.pop(nid, None)
-                        leftovers = [mt for mt, _ in st.frames]
+                        leftovers = [e[0] for e in st.frames]
                         st.frames.clear()
                         st.nbytes = 0
                     for mt in leftovers:
@@ -443,7 +467,7 @@ class TcpHub:
                     continue
 
     def _count_drop(self, receiver: int, msg_type) -> None:
-        mt = msg_type or "__hub__"
+        mt = msg_type or HUB_KEY
         with self._lock:
             self.dropped_frames[mt] = self.dropped_frames.get(mt, 0) + 1
         get_telemetry().inc("hub.dropped_frames", msg_type=mt)
@@ -549,7 +573,7 @@ class TcpBackend(CommBackend):
         # it, a send between "socket connected" and "hello written"
         # lands BEFORE the registration line and the hub parses the
         # message frame as the hello (KeyError, conn dropped, frame lost)
-        self._send_lock = threading.Lock()
+        self._send_lock = make_lock("TcpBackend._send_lock")
         self._dial()
 
     def _dial(self):
@@ -569,7 +593,7 @@ class TcpBackend(CommBackend):
                 # interleave it); afterwards, any frame sent TO this
                 # node can be delivered
                 ack = f.readline()
-                if not ack or json.loads(ack).get("__hub__") != "ack":
+                if not ack or json.loads(ack).get(HUB_KEY) != "ack":
                     raise ConnectionError(
                         f"node {self.node_id}: no hub ACK"
                     )
@@ -584,7 +608,7 @@ class TcpBackend(CommBackend):
                     self._clock_sync(sock, f)  # ping burst + ping_done
                 else:
                     sock.sendall(
-                        (json.dumps({"__hub__": "ping_done"}) + "\n").encode()
+                        (json.dumps({HUB_KEY: "ping_done"}) + "\n").encode()
                     )
             except BaseException:
                 try:
@@ -618,7 +642,7 @@ class TcpBackend(CommBackend):
         for _ in range(pings):
             t0 = time.perf_counter()
             sock.sendall((json.dumps(
-                {"__hub__": "ping", "t0": t0}
+                {HUB_KEY: "ping", "t0": t0}
             ) + "\n").encode())
             line = f.readline()
             t1 = time.perf_counter()
@@ -627,12 +651,12 @@ class TcpBackend(CommBackend):
                     f"node {self.node_id}: hub closed during clock sync"
                 )
             pong = json.loads(line)
-            if pong.get("__hub__") != "pong":
+            if pong.get(HUB_KEY) != "pong":
                 raise ConnectionError(
                     f"node {self.node_id}: bad clock-sync reply {pong!r}"
                 )
             samples.append((t0, pong.get("th"), t1))
-        sock.sendall((json.dumps({"__hub__": "ping_done"}) + "\n").encode())
+        sock.sendall((json.dumps({HUB_KEY: "ping_done"}) + "\n").encode())
         offset, rtt = trace_ctx.estimate_offset(samples)
         trace_ctx.record_clock_sync(self.node_id, offset, rtt, len(samples))
 
@@ -663,7 +687,8 @@ class TcpBackend(CommBackend):
                 if self._stopped.is_set() or attempt >= self.send_retries:
                     raise
                 get_telemetry().inc("comm.send_retries", msg_type=msg_type)
-                time.sleep(delay * (1.0 + random.random()))
+                time.sleep(delay * (1.0 + _retry_jitter(self.node_id,
+                                                        attempt)))
                 delay = min(delay * 2.0, 2.0)
 
     def send_message(self, msg: Message) -> None:
@@ -714,7 +739,7 @@ class TcpBackend(CommBackend):
             # per-receiver hub_out restamp
             inner = trace_ctx.restamp_parts(msg, inner, self.node_id, "send")
         head = (json.dumps({
-            "__hub__": "mcast",
+            HUB_KEY: "mcast",
             "receivers": receivers,
             "msg_type": msg.type,
             # binlen AFTER the restamp: the inner header line grew
@@ -763,7 +788,7 @@ class TcpBackend(CommBackend):
                 self._sock.settimeout(max(remaining, 0.05))
                 try:
                     self._sock.sendall(
-                        (json.dumps({"__hub__": "peers"}) + "\n").encode()
+                        (json.dumps({HUB_KEY: "peers"}) + "\n").encode()
                     )
                     line = self._file.readline()
                 except TimeoutError:
@@ -790,7 +815,7 @@ class TcpBackend(CommBackend):
                         f"node {self.node_id}: hub closed during await_peers"
                     )
                 frame = json.loads(line)
-                if frame.get("__hub__") == "peers":
+                if frame.get(HUB_KEY) == "peers":
                     if want <= set(frame.get("ids", [])):
                         return
                     _time.sleep(0.05)
@@ -889,7 +914,7 @@ class TcpBackend(CommBackend):
                         "node %d: reconnect failed", self.node_id
                     )
                     continue  # retry until the budget runs out
-            if frame.get("__hub__") == "stop":
+            if frame.get(HUB_KEY) == "stop":
                 return
             try:
                 # exact wire bytes: header line + binary payload

@@ -50,6 +50,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from fedml_tpu.comm.message import NDARRAY_KEY
+
 PyTree = Any
 
 # sub-stream index for compression randomness under the round key:
@@ -349,8 +351,8 @@ def wire_tree_digest(wire_obj: dict) -> str:
             for name in sorted(leaf["enc"]):
                 h.update(np.ascontiguousarray(
                     np.asarray(leaf["enc"][name])).tobytes())
-        elif isinstance(leaf, dict) and "__ndarray__" in leaf:
-            h.update(str(leaf["__ndarray__"]).encode())
+        elif isinstance(leaf, dict) and NDARRAY_KEY in leaf:
+            h.update(str(leaf[NDARRAY_KEY]).encode())
         else:
             h.update(np.ascontiguousarray(np.asarray(leaf)).tobytes())
     return h.hexdigest()

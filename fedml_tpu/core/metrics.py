@@ -87,7 +87,7 @@ class MetricsLogger:
         # in-flight time_* spans from the next round row
         if self.spans and "kind" not in record:
             record.update(self.pop_spans())
-        record.setdefault("ts", time.time())
+        record.setdefault("ts", time.time())  # fedlint: disable=determinism -- MetricsLogger IS the obs layer's writer (lives in core/ for import-order reasons); ts is record metadata
         self._write(record)
         if self._wandb:
             self._wandb.log(record, step=step)
@@ -100,7 +100,7 @@ class MetricsLogger:
         histogram is written.  Call at eval boundaries and at shutdown."""
         for ev in self.telemetry.drain_events():
             self._write(ev)
-        record = {"kind": "telemetry", "ts": time.time(),
+        record = {"kind": "telemetry", "ts": time.time(),  # fedlint: disable=determinism -- snapshot-record wall stamp (obs-role module); nothing replays it
                   **self.telemetry.snapshot()}
         self._write(record)
         return record

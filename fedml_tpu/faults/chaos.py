@@ -38,8 +38,9 @@ from typing import Callable, List, Optional
 
 import numpy as np
 
+from fedml_tpu.analysis.locks import make_lock
 from fedml_tpu.comm.backend import CommBackend, Observer
-from fedml_tpu.comm.message import Message
+from fedml_tpu.comm.message import NDARRAY_KEY, WIRETREE_KEY, Message
 from fedml_tpu.faults.plan import FaultPlan
 from fedml_tpu.obs import trace_ctx
 from fedml_tpu.obs.telemetry import get_telemetry
@@ -59,12 +60,12 @@ def _nan_leaf_twin(leaf) -> Optional[object]:
     server's corrupt-upload firewall fires exactly as for raw faults)."""
     from fedml_tpu.comm.message import _np_dtype
 
-    if isinstance(leaf, dict) and "__ndarray__" in leaf:
+    if isinstance(leaf, dict) and NDARRAY_KEY in leaf:
         dt = _np_dtype(leaf.get("dtype", "float32"))
         if not _is_float_dtype(dt):
             return None
         bad = np.full(leaf.get("shape") or (), np.nan, dtype=dt)
-        return {**leaf, "__ndarray__": base64.b64encode(bad.tobytes()).decode()}
+        return {**leaf, NDARRAY_KEY: base64.b64encode(bad.tobytes()).decode()}
     if isinstance(leaf, dict) and "enc" in leaf:
         enc = leaf["enc"]
         for name, arr in enc.items():
@@ -86,7 +87,7 @@ def corrupt_message(msg: Message, rng) -> Optional[Message]:
     dicts are never mutated in place (on inproc the same objects travel
     to the receiver)."""
     for key, value in msg.params.items():
-        if not (isinstance(value, dict) and "__wiretree__" in value):
+        if not (isinstance(value, dict) and WIRETREE_KEY in value):
             continue
         leaves = value.get("leaves") or []
         twins = [(i, t) for i, t in
@@ -126,6 +127,16 @@ class ChaosBackend(CommBackend):
     delivery trace ``tests/test_faults.py`` pins across runs.
     """
 
+    # lock-discipline contract (fedlint): sends run on the caller's
+    # thread, recv faults on the inner backend's reader thread, delay
+    # release on Timer threads — sequence numbers, held messages, AND
+    # the decision trace are all cross-thread state
+    _GUARDED_BY = {
+        "_seq": "_lock",
+        "_held": "_lock",
+        "trace": "_lock",
+    }
+
     def __init__(self, inner: CommBackend, plan: FaultPlan,
                  telemetry=None):
         super().__init__(inner.node_id)
@@ -135,7 +146,7 @@ class ChaosBackend(CommBackend):
         self.trace: List[tuple] = []
         self._seq = {}  # (direction, msg_type) -> next sequence number
         self._held = {"send": [], "recv": []}  # [remaining, msg] entries
-        self._lock = threading.Lock()
+        self._lock = make_lock("ChaosBackend._lock")
         # wall-clock transports (tcp) delay via timers; the inproc bus
         # delays via held-message ticks + a quiesce flush
         bus = getattr(inner, "bus", None)
@@ -145,12 +156,31 @@ class ChaosBackend(CommBackend):
         inner.add_observer(_Bridge(self))
 
     # -- fault application --------------------------------------------------
-    def _next_seq(self, direction: str, msg_type: str) -> int:
+    def _decide_traced(self, direction: str, msg_type: str, round_idx,
+                       receiver=None):
+        """Allocate the next per-(direction, msg_type) sequence number,
+        consult the plan, and append the decision to the pinned trace —
+        all in ONE critical section.  Sends (caller thread) and recv
+        faults (reader thread) interleave; with the seq allocated in a
+        separate lock scope from the append, the thread holding seq N
+        can lose the race to the thread holding N+1 and the trace
+        records them out of order — nondeterministic run-to-run, which
+        is exactly what the pinned-trace contract forbids.  The plan
+        decision is pure computation (rule matching + a seq-derived
+        rng), so holding the lock across it is cheap and lock-leaf."""
         with self._lock:
             key = (direction, msg_type)
             seq = self._seq.get(key, 0)
             self._seq[key] = seq + 1
-            return seq
+            acts = self.plan.decide(
+                self.node_id, direction, msg_type, seq, round_idx,
+                receiver=receiver,
+            )
+            self.trace.append(
+                (direction, msg_type, seq,
+                 tuple(a["action"] for a in acts) or ("deliver",))
+            )
+        return seq, acts
 
     def _inject(self, action: str, msg_type: str) -> None:
         self.telemetry.inc("faults.injected", action=action, msg_type=msg_type)
@@ -162,15 +192,9 @@ class ChaosBackend(CommBackend):
             forward(msg)
             self._tick(direction)
             return
-        seq = self._next_seq(direction, msg_type)
-        acts = self.plan.decide(
-            self.node_id, direction, msg_type, seq, msg.get("round_idx"),
-            receiver=receiver,
-        )
-        self.trace.append(
-            (direction, msg_type, seq,
-             tuple(a["action"] for a in acts) or ("deliver",))
-        )
+        seq, acts = self._decide_traced(direction, msg_type,
+                                        msg.get("round_idx"),
+                                        receiver=receiver)
         if any(a["action"] == "drop" for a in acts):
             self._inject("drop", msg_type)
             self._tick(direction)
@@ -298,15 +322,8 @@ class ChaosBackend(CommBackend):
             return
         clean = []
         for r in receivers:
-            seq = self._next_seq("send", msg.type)
-            acts = self.plan.decide(
-                self.node_id, "send", msg.type, seq, msg.get("round_idx"),
-                receiver=r,
-            )
-            self.trace.append(
-                ("send", msg.type, seq,
-                 tuple(a["action"] for a in acts) or ("deliver",))
-            )
+            seq, acts = self._decide_traced("send", msg.type,
+                                            msg.get("round_idx"), receiver=r)
             if any(a["action"] == "drop" for a in acts):
                 self._inject("drop", msg.type)
                 self._tick("send")

@@ -23,6 +23,11 @@ from __future__ import annotations
 
 from typing import Optional
 
+from fedml_tpu.comm.message import (
+    FRAME_NDBUF_KEY,
+    NDARRAY_KEY,
+    WIRETREE_KEY,
+)
 from fedml_tpu.obs.telemetry import Telemetry, get_telemetry
 
 # base64 expansion of binary buffers on the wire — applies ONLY to
@@ -95,15 +100,15 @@ def _value_nbytes(v, binary: bool = True) -> float:
     — the only path the factor still applies to (already-b64
     ``__ndarray__`` dicts are length-counted directly either way)."""
     if isinstance(v, dict):
-        if "__ndarray__" in v:  # already-encoded array: b64 string length
-            return len(v["__ndarray__"]) + 48
-        if "__ndbuf__" in v:  # binary buffer reference: exact
-            return float(v["__ndbuf__"][1]) + 48
-        if "__wiretree__" in v:  # wire pytree: sum its encoded leaves
+        if NDARRAY_KEY in v:  # already-encoded array: b64 string length
+            return len(v[NDARRAY_KEY]) + 48
+        if FRAME_NDBUF_KEY in v:  # binary buffer reference: exact
+            return float(v[FRAME_NDBUF_KEY][1]) + 48
+        if WIRETREE_KEY in v:  # wire pytree: sum its encoded leaves
             # a v2 tree's raw leaves are only exact when the FRAME is
             # binary too; through a v1 JSON line they b64-encode like
             # any array (the interop contract in message.py)
-            exact = v.get("__wiretree__") == 2 and binary
+            exact = v.get(WIRETREE_KEY) == 2 and binary
             return sum(_value_nbytes(l, binary=exact)
                        for l in v.get("leaves", ())) + 32
         return sum(len(str(k)) + 4 + _value_nbytes(x, binary)

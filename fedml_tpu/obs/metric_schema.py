@@ -1,0 +1,113 @@
+"""The checked-in telemetry naming registry — fedlint's source of truth.
+
+Every counter/gauge/histogram series and every telemetry-event kind the
+package emits is declared here, with its label set and one line of
+meaning.  The ``metric-name`` linter (``fedml_tpu/analysis``) verifies
+at CI time that every literal (and dynamic-pattern) name in code exists
+here with the matching type — so a typo'd series fails the lint instead
+of silently never aggregating — and PROFILE.md's metrics appendix cites
+THIS module instead of maintaining a hand-copied table that drifts.
+
+Conventions (``obs/telemetry.py``): rendered keys are
+``name{label=value,...}`` with sorted labels; histograms are
+log2-bucketed; names are ``<namespace>.<metric>``, cumulative gauges
+carry a ``_total`` suffix, histogram names end in their unit (``_s``,
+``_bytes``).
+
+Stdlib-only, pure literals: the lint CI executes this file on a bare
+interpreter, and the analysis fixtures exec it directly.
+"""
+
+from __future__ import annotations
+
+# --- counters (monotonic; Telemetry.inc) ------------------------------------
+COUNTERS = {
+    "comm.sent_msgs": "messages sent {msg_type=}",
+    "comm.sent_bytes": "exact wire bytes sent (tcp) / estimator (inproc) {msg_type=}",
+    "comm.recv_msgs": "messages delivered to observers {msg_type=}",
+    "comm.recv_bytes": "exact wire bytes received {msg_type=}",
+    "comm.raw_bytes": "logical fp32 bytes of codec-encoded payloads {msg_type=}",
+    "comm.compressed_bytes": "encoded bytes actually shipped {msg_type=}",
+    "comm.unhandled_msgs": "frames with no registered handler {msg_type=}",
+    "comm.send_retries": "bounded send retries after transient OSError {msg_type=}",
+    "comm.send_failed": "sends abandoned after the retry budget {msg_type=}",
+    "comm.reconnects": "hub re-dials by the auto-reconnect path",
+    "comm.mcast_sends": "native multicast frames sent {msg_type=}",
+    "comm.mcast_receivers": "receivers addressed by multicast frames {msg_type=}",
+    "hub.mcast_frames": "mcast control frames fanned out by the hub {msg_type=}",
+    "hub.dropped_frames": "frames to unregistered/dead/over-bound receivers {msg_type=}",
+    "faults.injected": "chaos-layer injections {action=,msg_type=}",
+    "faults.observed": "tolerance-layer observations {kind=,msg_type=}",
+    "rounds.degraded": "rounds closed under the aggregation target",
+    "jax.compiles": "jit compilations per instrumented fn {fn=}",
+    "jax.backend_compile_events": "runtime jax.monitoring compile events {event=}",
+}
+
+# --- gauges (instantaneous, or cumulative with _total; gauge_set/max) --------
+GAUGES = {
+    "hub.connections": "currently registered hub connections",
+    "hub.send_queue_frames": "per-connection outbound queue depth {node=}",
+    "hub.send_queue_bytes": "per-connection outbound queue bytes {node=}",
+    "hub.backpressure_drops_total": "cumulative over-bound queue drops",
+    "hub.mcast_frames_total": "cumulative mcast frames (time series form)",
+    "jax.device_mem_bytes": "device memory in use {device=}",
+    "jax.device_mem_peak_bytes": "high-water device memory {device=}",
+    "clock.hub_offset_s": "estimated monotonic-clock offset to the hub {node=}",
+    "clock.hub_rtt_s": "min round-trip of the clock-sync burst {node=}",
+}
+
+# --- histograms (log2-bucketed; Telemetry.observe) ---------------------------
+HISTOGRAMS = {
+    "comm.send_latency_s": "time inside send_message (serialize + write) {msg_type=}",
+    "comm.handle_latency_s": "NodeManager handler time {msg_type=}",
+    "span.agg_fold_s": "per-arrival streaming-aggregation fold",
+    "span.agg_s": "close-time aggregation (buffered mode / normalize)",
+    "span.server_round_s": "server round wall time, open to close",
+    "span.reconnect_s": "outage span, first EOF to re-registered",
+    "span.traced_round_s": "per-round synced seconds under trace_rounds",
+    "jax.compile_s": "wall time of compile-triggering calls {fn=}",
+    "jax.backend_compile_s": "runtime-reported compile durations {event=}",
+}
+
+# --- dynamic-name patterns ---------------------------------------------------
+# MetricsLogger.span(name) emits f"span.{name}_s" for driver-defined
+# span names (sample/pack/round/eval/...): any span.*_s is a histogram.
+METRIC_PATTERNS = {
+    "span.*_s": "histogram",
+}
+
+# --- telemetry event kinds (Telemetry.event + MetricsLogger records) ---------
+EVENTS = {
+    "compile": "one jit compilation {fn, signature, seconds}",
+    "trace": "profiler trace written {trace_dir}",
+    "trace_rounds": "profiler round bracketing {trace_dir, per-round seconds}",
+    "config": "the full experiment dataclass (MetricsLogger record)",
+    "telemetry": "registry snapshot record (MetricsLogger.log_telemetry)",
+    "resume": "checkpoint resume {round}",
+    "degraded_round": "round closed under target {round, arrived, dropped}",
+    "round_close": "round boundary {round, participants, t_open_m, t_close_m}",
+    "hub_stats": "hub queue-depth/backpressure snapshot (1 s timer)",
+    "clock_sync": "dial-handshake offset estimate {node, offset_s, rtt_s}",
+    "trace_hop": "full per-message hop chain (receiver-side emission)",
+}
+
+# flat view used by the linter and by tools that just need existence
+METRICS = {
+    **{name: "counter" for name in COUNTERS},
+    **{name: "gauge" for name in GAUGES},
+    **{name: "histogram" for name in HISTOGRAMS},
+}
+
+
+def metric_type(name: str) -> str:
+    """'counter' | 'gauge' | 'histogram' | '' for a series name (exact
+    match first, then the dynamic patterns)."""
+    kind = METRICS.get(name)
+    if kind:
+        return kind
+    import fnmatch
+
+    for pat, ptype in METRIC_PATTERNS.items():
+        if fnmatch.fnmatchcase(name, pat):
+            return ptype
+    return ""

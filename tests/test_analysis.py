@@ -1,0 +1,563 @@
+"""fedlint static-analysis suite + CheckedLock runtime harness.
+
+Covers ISSUE 7's acceptance bar:
+
+- every rule has a fixture-verified FAILING case (in-memory fixture
+  files at virtual package paths, so checker scoping is exercised);
+- pragma suppression is honored WITH a justification and rejected
+  without one;
+- the real tree is finding-free (``python tools/fedlint.py fedml_tpu``
+  exits 0 — asserted both in-process and through the CLI);
+- the deterministic TCP retry jitter is pinned;
+- the CheckedLock harness records an ACYCLIC lock-order graph under a
+  real federation + concurrent-send stress, and catches order cycles,
+  recursive acquires, and broken ``holds=`` contracts when they do
+  happen.
+"""
+
+import json
+import subprocess
+import sys
+import textwrap
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from fedml_tpu.analysis import RULES, load_files, run_all
+from fedml_tpu.analysis import locks as cl
+from fedml_tpu.analysis.base import SourceFile
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def make(rel: str, src: str) -> SourceFile:
+    return SourceFile(textwrap.dedent(src), rel=rel)
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# --- determinism -------------------------------------------------------------
+
+DET_BAD = """
+    import random
+    import time
+    import numpy as np
+
+    def jitter():
+        return random.random()
+
+    def draw():
+        return np.random.rand(3)
+
+    def unseeded():
+        return np.random.RandomState()
+
+    def stamp():
+        return time.time()
+"""
+
+DET_GOOD = """
+    import time
+    import numpy as np
+
+    def seeded(seed):
+        return np.random.RandomState(seed).rand(3)
+
+    def span():
+        return time.perf_counter()
+"""
+
+
+def test_determinism_fixture_violations():
+    findings = run_all([make("fedml_tpu/comm/fixture.py", DET_BAD)],
+                       rules=["determinism"])
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 4, findings
+    assert "random.random" in msgs
+    assert "np.random.rand" in msgs
+    assert "RandomState()" in msgs and "without a seed" in msgs
+    assert "time.time" in msgs
+
+
+def test_determinism_seeded_and_monotonic_pass():
+    assert run_all([make("fedml_tpu/comm/fixture.py", DET_GOOD)],
+                   rules=["determinism"]) == []
+
+
+def test_determinism_scope_excludes_obs_and_experiments():
+    for rel in ("fedml_tpu/obs/fixture.py", "fedml_tpu/experiments/fx.py"):
+        assert run_all([make(rel, DET_BAD)], rules=["determinism"]) == []
+
+
+# --- jit-purity --------------------------------------------------------------
+
+JIT_BAD = """
+    import time
+    import jax
+
+    def helper(x):
+        print(x)
+        return x
+
+    @jax.jit
+    def step(x):
+        return helper(x)
+
+    def make_fn():
+        def inner(x):
+            t = time.time()
+            return x * t
+        return jax.jit(inner)
+
+    def unreachable(x):
+        print(x)  # impure but never jitted: must NOT be flagged
+        return x
+"""
+
+JIT_GOOD = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def step(x, key):
+        return x + jax.random.normal(key, x.shape)
+"""
+
+
+def test_jit_purity_fixture_violations():
+    findings = run_all([make("fedml_tpu/parallel/fixture.py", JIT_BAD)],
+                       rules=["jit-purity"])
+    msgs = "\n".join(f.message for f in findings)
+    assert len(findings) == 2, findings
+    assert "print" in msgs  # via the decorator root, one call-graph hop
+    assert "time.time" in msgs  # via the jax.jit(inner) call-site root
+    assert "unreachable" not in msgs
+
+
+def test_jit_purity_partial_decorator_and_shard_map_alias():
+    src = """
+        from functools import partial
+        import jax
+        shard_map = jax.shard_map
+
+        @partial(jax.jit, static_argnames=("p",))
+        def combine(x, p):
+            print(p)
+            return x
+
+        def local(x):
+            x = x.sum().item()
+            return x
+
+        sharded = shard_map(local, mesh=None)
+    """
+    findings = run_all([make("fedml_tpu/parallel/fx2.py", src)],
+                       rules=["jit-purity"])
+    msgs = "\n".join(f.message for f in findings)
+    assert "print" in msgs
+    assert ".item()" in msgs
+
+
+def test_jit_purity_clean_fixture_passes():
+    assert run_all([make("fedml_tpu/parallel/fixture.py", JIT_GOOD)],
+                   rules=["jit-purity"]) == []
+
+
+# --- wire-schema -------------------------------------------------------------
+
+def test_wire_schema_literal_outside_definer_flagged():
+    src = """
+        def route(frame):
+            return frame.get("__hub__")
+    """
+    findings = run_all([make("fedml_tpu/experiments/fx.py", src)],
+                       rules=["wire-schema"])
+    assert len(findings) == 1
+    assert "HUB_KEY" in findings[0].message
+
+
+def test_wire_schema_canonical_definition_passes_extra_literal_fails():
+    src = """
+        HUB_KEY = "__hub__"
+
+        def also_uses_literal(frame):
+            return frame.get("__hub__")
+    """
+    findings = run_all([make("fedml_tpu/comm/message.py", src)],
+                       rules=["wire-schema"])
+    assert len(findings) == 1  # the def passes; the second literal fails
+    assert findings[0].line == 5  # the frame.get literal, not the definition
+
+
+# --- metric-name -------------------------------------------------------------
+
+FIXTURE_SCHEMA = """
+    COUNTERS = {"good.counter": "a counter"}
+    GAUGES = {"good.gauge": "a gauge"}
+    HISTOGRAMS = {"good.hist_s": "a histogram"}
+    METRIC_PATTERNS = {"span.*_s": "histogram"}
+    EVENTS = {"good_event": "an event"}
+"""
+
+METRIC_CODE = """
+    def emit(t, name, h, v):
+        t.inc("good.counter")
+        t.inc("bad.counter")
+        t.observe("good.counter", 1.0)
+        t.observe(f"span.{name}_s", 1.0)
+        t.inc(f"span.{name}_s")
+        t.gauge_set("good.gauge", 2)
+        t.event("good_event", x=1)
+        t.event("typo_event", x=1)
+        h.observe(v)
+"""
+
+
+def _metric_findings():
+    files = [
+        make("fedml_tpu/obs/metric_schema.py", FIXTURE_SCHEMA),
+        make("fedml_tpu/core/fx.py", METRIC_CODE),
+    ]
+    return run_all(files, rules=["metric-name"])
+
+
+def test_metric_name_fixture_violations():
+    findings = _metric_findings()
+    msgs = [f.message for f in findings]
+    assert len(findings) == 4, findings
+    assert any("'bad.counter' is not registered" in m for m in msgs)
+    assert any("registered as a counter but emitted here as a histogram" in m
+               for m in msgs)  # observe("good.counter")
+    assert any("span.*_s" in m and "counter" in m for m in msgs)  # inc(f"span...")
+    assert any("typo_event" in m for m in msgs)
+    # h.observe(v) — non-string first arg — must not produce a finding
+    assert all("good.gauge" not in m and "good_event\n" not in m for m in msgs)
+
+
+def test_metric_schema_matches_profile_namespaces():
+    """The registry is the single source PROFILE.md cites: spot-check
+    that the namespaces the appendix documents exist in the schema."""
+    from fedml_tpu.obs import metric_schema as ms
+
+    for name in ("comm.sent_bytes", "comm.send_latency_s", "jax.compiles",
+                 "hub.mcast_frames", "faults.injected", "rounds.degraded"):
+        assert ms.metric_type(name), name
+    assert ms.metric_type("span.agg_fold_s") == "histogram"
+    assert ms.metric_type("span.pack_s") == "histogram"  # dynamic pattern
+    assert ms.metric_type("no.such_series") == ""
+    assert "trace_hop" in ms.EVENTS and "clock_sync" in ms.EVENTS
+
+
+# --- lock-discipline ---------------------------------------------------------
+
+LOCK_FIXTURE = """
+    import threading
+
+    class Box:
+        _GUARDED_BY = {"items": "_lock"}
+
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.items = []
+
+        def good(self):
+            with self._lock:
+                self.items.append(1)
+
+        def bad(self):
+            self.items.append(1)
+
+        def held(self):  # fedlint: holds=_lock
+            self.items.append(1)
+
+        def nested(self):
+            with self._lock:
+                def cb():
+                    return self.items.pop()
+                return cb
+"""
+
+
+def test_lock_discipline_fixture():
+    findings = run_all([make("fedml_tpu/comm/fx.py", LOCK_FIXTURE)],
+                       rules=["lock-discipline"])
+    lines = sorted(f.line for f in findings)
+    assert len(findings) == 2, findings
+    # 'bad' touches items unlocked; the nested callable resets the held
+    # set (it runs later, on an arbitrary thread).  __init__, 'good',
+    # and the holds-annotated 'held' all pass.
+    assert "bad" in findings[0].message or "bad" in findings[1].message
+    assert any("nested" in f.message for f in findings)
+    assert lines[0] < lines[1]
+
+
+# --- pragmas -----------------------------------------------------------------
+
+def test_pragma_with_justification_suppresses():
+    src = """
+        import random
+
+        def jitter():
+            return random.random()  # fedlint: disable=determinism -- fixture: documented-unsafe path
+    """
+    assert run_all([make("fedml_tpu/comm/fx.py", src)],
+                   rules=["determinism"]) == []
+
+
+def test_pragma_without_justification_is_its_own_finding():
+    src = """
+        import random
+
+        def jitter():
+            return random.random()  # fedlint: disable=determinism
+    """
+    findings = run_all([make("fedml_tpu/comm/fx.py", src)],
+                       rules=["determinism"])
+    assert rules_of(findings) == ["determinism", "pragma"]
+    # the bare pragma does NOT suppress: the original finding survives
+    assert any("justification" in f.message for f in findings)
+
+
+def test_pragma_only_suppresses_named_rule():
+    src = """
+        import random
+
+        def jitter():
+            return random.random()  # fedlint: disable=wire-schema -- wrong rule on purpose
+    """
+    findings = run_all([make("fedml_tpu/comm/fx.py", src)],
+                       rules=["determinism"])
+    assert rules_of(findings) == ["determinism"]
+
+
+# --- the real tree is clean --------------------------------------------------
+
+def test_clean_tree_no_findings():
+    """THE acceptance criterion: zero un-pragma'd findings over the
+    package, all five rules."""
+    findings = run_all(load_files(REPO / "fedml_tpu"))
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_cli_json_exit_codes(tmp_path):
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "fedlint.py"),
+         str(REPO / "fedml_tpu"), "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 0, out.stdout + out.stderr
+    payload = json.loads(out.stdout)
+    assert payload["ok"] is True and payload["files_scanned"] > 100
+
+    bad = tmp_path / "bad.py"
+    bad.write_text('KEY = {"__hub__": "stop"}\nOTHER = "__hub__"\n')
+    out = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "fedlint.py"),
+         str(bad), "--rules", "wire-schema", "--json"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert out.returncode == 1
+    payload = json.loads(out.stdout)
+    assert payload["counts"].get("wire-schema") == 2
+
+
+# --- deterministic retry jitter (satellite) ----------------------------------
+
+def test_retry_jitter_deterministic_and_pinned():
+    from fedml_tpu.comm.tcp import _retry_jitter
+
+    # pure function of (node, attempt): pinned across processes/re-runs
+    # (sha256-derived — these constants are forever)
+    assert _retry_jitter(1, 0) == pytest.approx(0.3095577024128878)
+    assert _retry_jitter(1, 1) == pytest.approx(0.929382797820545)
+    assert _retry_jitter(2, 0) == pytest.approx(0.12585080322746847)
+    vals = [_retry_jitter(n, a) for n in range(8) for a in range(4)]
+    assert all(0.0 <= v < 1.0 for v in vals)
+    assert len(set(vals)) == len(vals)  # streams do not collide
+    assert vals == [_retry_jitter(n, a) for n in range(8) for a in range(4)]
+
+
+# --- CheckedLock runtime -----------------------------------------------------
+
+@pytest.fixture
+def checked_locks():
+    cl.set_enabled(True)
+    cl.reset()
+    yield
+    cl.set_enabled(None)
+    cl.reset()
+
+
+def test_make_lock_plain_when_disabled():
+    cl.set_enabled(False)
+    try:
+        lock = cl.make_lock("x")
+        assert not isinstance(lock, cl.CheckedLock)
+        cl.assert_held(lock)  # no-op on plain locks
+    finally:
+        cl.set_enabled(None)
+
+
+def test_checked_lock_order_graph_and_cycle(checked_locks):
+    a, b = cl.CheckedLock("a"), cl.CheckedLock("b")
+    with a:
+        with b:
+            pass
+    assert ("a", "b") in cl.lock_order_edges()
+    cl.assert_acyclic()
+    with b:
+        with a:
+            pass
+    cycle = cl.find_cycle()
+    assert cycle is not None and cycle[0] == cycle[-1]
+    with pytest.raises(cl.LockDisciplineError, match="cycle"):
+        cl.assert_acyclic()
+
+
+def test_checked_lock_recursive_acquire_raises(checked_locks):
+    a = cl.CheckedLock("a")
+    with a:
+        with pytest.raises(cl.LockDisciplineError, match="recursive"):
+            a.acquire()
+
+
+def test_checked_lock_foreign_release_raises(checked_locks):
+    a = cl.CheckedLock("a")
+    with pytest.raises(cl.LockDisciplineError, match="does not hold"):
+        a.release()
+
+
+def test_assert_held_contract(checked_locks):
+    a = cl.CheckedLock("a")
+    with pytest.raises(cl.LockDisciplineError, match="without holding"):
+        cl.assert_held(a, "guarded thing")
+    with a:
+        cl.assert_held(a, "guarded thing")
+    assert not a.held_by_me()
+
+
+def test_holds_contract_violation_caught_at_runtime(checked_locks):
+    """_close_round's '# fedlint: holds=_round_lock' promise is real:
+    entering it without the lock raises under checked locks."""
+    import jax
+
+    from fedml_tpu.algorithms.fedavg_cross_device import FedAvgServerManager
+    from fedml_tpu.comm.inproc import InprocBus
+    from fedml_tpu.models.linear import logistic_regression
+
+    bundle = logistic_regression(4, 2)
+    init = bundle.init(jax.random.PRNGKey(0))
+    bus = InprocBus()
+    server = FedAvgServerManager(
+        bus.register(0), init, num_clients=1, clients_per_round=1,
+        comm_rounds=1, seed=0,
+    )
+    with pytest.raises(cl.LockDisciplineError, match="_close_round"):
+        server._close_round()
+
+
+def test_federation_stress_under_checked_locks_acyclic(checked_locks):
+    """The acceptance harness: a real TCP federation (server manager
+    holding _round_lock across transport sends) plus the concurrent-
+    send pattern from the PR-5 stress test, all on CheckedLocks — no
+    discipline violations, every frame intact, and the recorded
+    lock-order graph is acyclic and non-trivial."""
+    import jax
+
+    from fedml_tpu.algorithms.fedavg_cross_device import (
+        FedAvgClientManager,
+        FedAvgServerManager,
+    )
+    from fedml_tpu.comm.message import Message
+    from fedml_tpu.comm.tcp import TcpBackend, TcpHub
+    from fedml_tpu.core.client import make_client_optimizer, make_local_update
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models.linear import logistic_regression
+
+    ds = synthetic_classification(
+        num_train=60, num_test=20, input_shape=(8,), num_classes=2,
+        num_clients=2, partition="homo", seed=3,
+    )
+    bundle = logistic_regression(8, 2)
+    init = bundle.init(jax.random.PRNGKey(3))
+    lu = make_local_update(bundle, make_client_optimizer("sgd", 0.1), 1)
+
+    hub = TcpHub()
+    assert isinstance(hub._lock, cl.CheckedLock)
+    server_backend = TcpBackend(0, hub.host, hub.port)
+    client_backends = [TcpBackend(i + 1, hub.host, hub.port)
+                       for i in range(2)]
+    server = FedAvgServerManager(
+        server_backend, init, num_clients=2, clients_per_round=2,
+        comm_rounds=2, seed=3,
+    )
+    assert isinstance(server._round_lock, cl.CheckedLock)
+    clients = [
+        FedAvgClientManager(cb, lu, ds, batch_size=16,
+                            template_variables=init, seed=3)
+        for cb in client_backends
+    ]
+    threads = [cb.run_in_thread() for cb in client_backends]
+    server_thread = server_backend.run_in_thread()
+
+    # concurrent-send pressure on the same hub while the rounds run
+    extra_recv = []
+    recv_lock = threading.Lock()
+
+    class Obs:
+        def receive_message(self, t, m):
+            with recv_lock:
+                extra_recv.append(np.asarray(m.get("data")))
+
+    sink = TcpBackend(9, hub.host, hub.port)
+    sink.add_observer(Obs())
+    sink.run_in_thread()
+    blaster = TcpBackend(8, hub.host, hub.port)
+    blaster.await_peers([9])
+
+    def blast(tid):
+        for k in range(3):
+            m = Message("STRESS", 8, 9)
+            m.add_params("tag", tid * 10 + k)
+            m.add_params("data",
+                         np.full(50_000, float(tid * 10 + k), np.float32))
+            blaster.send_message(m)
+
+    blast_threads = [threading.Thread(target=blast, args=(i,))
+                     for i in range(3)]
+    server.start()
+    for t in blast_threads:
+        t.start()
+    server_thread.join(timeout=60)
+    assert not server_thread.is_alive(), "server did not finish"
+    assert server.round_idx == 2
+    for t in blast_threads:
+        t.join(timeout=10)
+    import time as _t
+    deadline = _t.monotonic() + 20
+    while _t.monotonic() < deadline:
+        with recv_lock:
+            if len(extra_recv) >= 9:
+                break
+        _t.sleep(0.05)
+    for t in threads:
+        t.join(timeout=10)
+    for b in (sink, blaster):
+        b.stop()
+    hub.stop()
+
+    assert len(extra_recv) == 9, f"stress frames lost: {len(extra_recv)}/9"
+    for arr in extra_recv:
+        assert np.all(arr == arr.flat[0])  # no torn frames
+    # the graph saw real nesting (round lock held across transport
+    # sends) and is acyclic — the deadlock-freedom evidence
+    edges = cl.lock_order_edges()
+    assert ("FedAvgServerManager._round_lock",
+            "TcpBackend._send_lock") in edges, edges
+    cl.assert_acyclic()
+    assert all(np.isfinite(np.asarray(l)).all()
+               for l in jax.tree_util.tree_leaves(server.variables))

@@ -32,6 +32,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from fedml_tpu.parallel.compat import shard_map
 from fedml_tpu.comm.backend import CommBackend, NodeManager
 from fedml_tpu.comm.inproc import InprocBus
 from fedml_tpu.comm.message import (
@@ -215,7 +216,7 @@ def make_compiled_round(
         local = local_compute_jax(client_ids, round_idx, global_result)
         return lax.psum(jnp.sum(local), axis)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         _round, mesh=mesh, in_specs=(P(axis), P(), P()), out_specs=P()
     )
 

@@ -43,7 +43,9 @@ def dense_mix(stacked_vars: PyTree, w: jax.Array) -> PyTree:
 
 def ring_mix(local_vars: PyTree, axis_name: str, w_self=1 / 3, w_left=1 / 3, w_right=1 / 3):
     """Ring mixing via two ppermutes over a mesh axis (one client/device)."""
-    n = jax.lax.axis_size(axis_name)
+    # lax.psum of a constant is the static axis size on every
+    # supported jax (lax.axis_size only exists on new jax)
+    n = jax.lax.psum(1, axis_name)
     left = [(i, (i + 1) % n) for i in range(n)]
     right = [(i, (i - 1) % n) for i in range(n)]
     return jax.tree_util.tree_map(

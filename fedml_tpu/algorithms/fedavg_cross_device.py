@@ -114,6 +114,9 @@ class FedAvgServerManager(NodeManager):
         "round_log": "_round_lock",
         "rejected_uploads": "_round_lock",
         "zero_participant_rounds": "_round_lock",
+        "_last_decode_wait_s": "_round_lock",
+        "_last_decode_s": "_round_lock",
+        "_bcast_task_s": "_round_lock",
     }
 
     def __init__(
@@ -131,6 +134,7 @@ class FedAvgServerManager(NodeManager):
         codec: str = "none",
         multicast: bool = True,
         streaming_agg: bool = True,
+        decode_workers: int = 0,
     ):
         import threading
 
@@ -187,6 +191,36 @@ class FedAvgServerManager(NodeManager):
         # completed normally) is a no-op
         self._round_lock = make_lock("FedAvgServerManager._round_lock")
         self._deadline_timer: Optional[threading.Timer] = None
+        # decode/fold pipeline (``decode_workers > 0``): upload decode +
+        # the finite firewall move OFF the backend reader thread onto a
+        # small worker pool feeding the streaming fold, so decode of
+        # client i's upload overlaps the wire receive of client i+1 —
+        # and the next broadcast's encode+send runs on its own thread
+        # (double-buffered) so stale/spare uploads never queue behind an
+        # O(model) serialize.  0 (default) = the synchronous path: the
+        # inproc bus's deterministic drain contract requires handlers to
+        # complete inline, so only the real TCP entry points turn this
+        # on (``distributed_fedavg --decode-workers``).
+        self.decode_workers = max(0, int(decode_workers))
+        if self.decode_workers:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._decode_pool = ThreadPoolExecutor(
+                max_workers=self.decode_workers,
+                thread_name_prefix="fed-decode",
+            )
+            self._encode_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="fed-bcast"
+            )
+        else:
+            self._decode_pool = None
+            self._encode_pool = None
+        # per-round pipeline evidence, surfaced on the round_close
+        # event/record: the CLOSING upload's decode queue wait + decode
+        # time, and the previous broadcast's off-thread encode+send span
+        self._last_decode_wait_s = 0.0
+        self._last_decode_s = 0.0
+        self._bcast_task_s = 0.0
         super().__init__(backend)
 
     def register_message_receive_handlers(self):
@@ -332,13 +366,47 @@ class FedAvgServerManager(NodeManager):
             # close would swap self.variables; the post-decode stale
             # re-check then discards anything decoded against it)
             base = self.variables
+        if self._decode_pool is not None:
+            # pipeline: hand decode+fold to the worker pool and free
+            # the reader thread for the next frame — decode of upload i
+            # overlaps the wire receive of upload i+1
+            self._decode_pool.submit(
+                self._decode_and_fold, msg, base, reply_round,
+                time.perf_counter(),
+            )
+            return
+        self._decode_and_fold(msg, base, reply_round, None)
+
+    def _decode_and_fold(self, msg: Message, base, reply_round,
+                         t_submit: Optional[float]) -> None:
+        try:
+            self._decode_and_fold_inner(msg, base, reply_round, t_submit)
+        except Exception:
+            # a pool task's exception dies in its Future — the upload
+            # would silently vanish and the round hang to its deadline
+            # with no attributable cause.  Log + count like any other
+            # bad upload.
+            logging.exception("upload decode/fold failed for node %d",
+                              msg.sender)
+            self._reject_upload(msg.sender, "undecodable_upload")
+
+    def _decode_and_fold_inner(self, msg: Message, base, reply_round,
+                               t_submit: Optional[float]) -> None:
+        wait_s = 0.0
+        t_start = time.perf_counter()
+        if t_submit is not None:
+            # decode queue wait: reader-thread submit -> pool pickup —
+            # the pipeline's backpressure signal (grows when K uploads
+            # outpace the decode workers)
+            wait_s = t_start - t_submit
+            get_telemetry().observe("span.decode_wait_s", wait_s)
         # decode + validate OUTSIDE the round lock: both are O(model)
-        # (multi-MB b64 decode, full-tree finite scan) and K near-
+        # (multi-MB decode, full-tree finite scan) and K near-
         # simultaneous uploads would otherwise serialize behind one
         # lock with the deadline timer blocked at the back of the queue
         try:
             payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
-            variables = tree_from_wire(payload, self.variables)
+            variables = tree_from_wire(payload, base)
             if tree_is_delta(payload):
                 # codec-encoded UPDATE: decoded leaves are fp32 deltas;
                 # the upload's model is base + delta (what the client's
@@ -362,11 +430,18 @@ class FedAvgServerManager(NodeManager):
         ):
             self._reject_upload(msg.sender, "corrupt_upload")
             return
+        decode_s = time.perf_counter() - t_start
+        get_telemetry().observe("span.decode_s", decode_s)
         with self._round_lock:
             # re-check: the round may have closed (deadline, or the
             # K-th other reporter) while this upload was decoding
             if self._is_stale(msg, reply_round):
                 return
+            # pipeline evidence for the round that this upload counts
+            # toward: the LAST accepted upload's numbers are the
+            # closing chain's (round_close reads them under this lock)
+            self._last_decode_wait_s = wait_s
+            self._last_decode_s = decode_s
             meta = {"n": n,
                     "metrics": msg.get(MSG_ARG_KEY_LOCAL_METRICS) or {}}
             if msg.sender in self.pending:
@@ -515,15 +590,27 @@ class FedAvgServerManager(NodeManager):
                 "sampled %s) — global model unchanged this round",
                 self.round_idx, self.round_timeout or -1.0, sorted(sampled),
             )
+        # pipeline evidence riding the round boundary: the closing
+        # upload's decode queue wait + decode span, and the PREVIOUS
+        # broadcast's off-thread encode+send span (it opened this
+        # round) — what fed_timeline reads for its decode_wait /
+        # encode_overlap phases
+        rec["decode_wait_s"] = round(self._last_decode_wait_s, 6)
+        rec["decode_s"] = round(self._last_decode_s, 6)
+        rec["encode_overlap_s"] = round(self._bcast_task_s, 6)
         # the same record as a telemetry event: the server's
         # metrics-node0.jsonl then carries round boundaries next to its
         # trace_hop chains, so the timeline merger reads ONE stream
         tel.event("round_close", round=self.round_idx,
                   participants=len(self.pending), time_agg=rec["time_agg"],
-                  t_open_m=rec["t_open_m"], t_close_m=rec["t_close_m"])
+                  t_open_m=rec["t_open_m"], t_close_m=rec["t_close_m"],
+                  decode_wait_s=rec["decode_wait_s"],
+                  decode_s=rec["decode_s"],
+                  encode_overlap_s=rec["encode_overlap_s"])
         self.round_log.append(rec)
         self.pending.clear()
         self._agg_acc, self._agg_n = None, 0.0
+        self._last_decode_wait_s = self._last_decode_s = 0.0
         self.round_idx += 1
         if self.round_idx >= self.comm_rounds:
             nodes = list(range(1, self.num_clients + 1))
@@ -546,8 +633,63 @@ class FedAvgServerManager(NodeManager):
             self.finish()
             return
         self._round_open_t = time.perf_counter()
-        self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL)
-        self._arm_deadline()
+        if self._encode_pool is not None:
+            # double-buffered broadcast: the O(model) wire encode + hub
+            # write runs on the dedicated encode thread so the caller
+            # (a decode worker or the deadline timer) releases the
+            # round lock immediately — residual/stale uploads decode
+            # while the next sync serializes.  Safe lock-free reads:
+            # self.variables/round_idx only change at the NEXT close,
+            # which cannot happen before this broadcast reaches clients.
+            self._encode_pool.submit(self._broadcast_async, self.round_idx)
+        else:
+            self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL)
+            self._arm_deadline()
+
+    def _broadcast_async(self, round_gen: int) -> None:
+        """Encode-thread body: broadcast the new round's sync, record
+        the overlapped span, then arm the deadline (the deadline must
+        not start ticking before the sync is on the wire — same
+        ordering as the synchronous path)."""
+        t0 = time.perf_counter()
+        try:
+            self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL)
+        except Exception:
+            # _broadcast_model already downgrades OSError under a
+            # deadline; anything else must still not kill the encode
+            # thread — the deadline below keeps the federation moving
+            logging.exception("round %d: async broadcast failed",
+                              round_gen)
+            if self.round_timeout is None:
+                # fail-fast contract (_broadcast_model's own
+                # no-deadline branch): with no deadline nothing can
+                # ever recover a lost sync, and an exception on this
+                # pool thread dies in its Future — re-raising would be
+                # a SILENT permanent hang.  Tear the federation down
+                # visibly instead.
+                logging.critical(
+                    "round %d: broadcast lost with no round deadline — "
+                    "shutting the federation down (fail-fast)", round_gen,
+                )
+                self.finish()
+                return
+        dt = time.perf_counter() - t0
+        get_telemetry().observe("span.encode_overlap_s", dt)
+        with self._round_lock:
+            self._bcast_task_s = dt
+            if round_gen == self.round_idx:
+                self._arm_deadline()
+
+    def finish(self) -> None:
+        # non-blocking shutdown: the final _close_round runs ON a
+        # decode worker when pipelining, and a wait=True here would
+        # join the thread into itself.  Workers drain naturally; any
+        # still-queued stale decode lands on a stopped backend's
+        # rejection path.
+        for pool in (self._decode_pool, self._encode_pool):
+            if pool is not None:
+                pool.shutdown(wait=False)
+        super().finish()
 
     def _send_or_log(self, msg: Message) -> None:
         """Broadcast sends must not abort the round loop: a sync the

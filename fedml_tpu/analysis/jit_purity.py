@@ -53,6 +53,9 @@ JIT_TRANSFORMS = {
     "jax.experimental.pjit.pjit",
     "jax.shard_map",
     "jax.experimental.shard_map.shard_map",
+    # the package's version-tolerant shim — call sites import the
+    # transform from here, and they are jit roots all the same
+    "fedml_tpu.parallel.compat.shard_map",
 }
 
 HOST_CLOCKS = {

@@ -67,6 +67,14 @@ class CommBackend(abc.ABC):
         for r in receivers:
             self.send_message(msg.clone_for(int(r)))
 
+    def set_stripe_fault_hook(self, hook) -> None:
+        """Install a per-stripe fault hook (chaos layer).  Transports
+        without striped delivery (inproc; tcp wire 1) have no stripes
+        to fault — the base implementation ignores the hook, so a
+        stripe-faulting plan degrades to a no-op instead of an
+        AttributeError on those transports.  ``TcpBackend`` overrides
+        with its reassembly-path hook."""
+
     @abc.abstractmethod
     def run(self) -> None:
         """Deliver incoming messages to observers until stopped."""

@@ -40,6 +40,10 @@ import numpy as np
 # canonical one evolves).  ``TRACE_KEY`` (= "__trace__") lives in
 # ``obs/trace_ctx.py`` with the same contract.
 HUB_KEY = "__hub__"  # hub control frames (register/ack/ping/mcast/stop)
+# __hub__ kind of a striped-multicast continuation frame: the hub splits
+# a large mcast payload into fixed-size stripes fanned round-robin
+# across connections; receivers reassemble (comm/tcp.py)
+MCAST_STRIPE_KIND = "mcast_stripe"
 FRAME_BINLEN_KEY = "__binlen__"  # header: raw payload bytes that follow
 FRAME_NDBUF_KEY = "__ndbuf__"  # header entry: [offset, nbytes] buffer ref
 WIRETREE_KEY = "__wiretree__"  # wire pytree envelope (version tag)
@@ -161,6 +165,31 @@ class Message:
         raw payload bytes that followed it."""
         obj = {k: v for k, v in header_obj.items() if k != FRAME_BINLEN_KEY}
         return cls.from_obj(_inject_buffers(obj, payload))
+
+    @classmethod
+    def from_frame_bytes(cls, data: bytes) -> "Message":
+        """Parse ONE complete binary frame held in memory (header line +
+        raw payload): the stripe-reassembly inverse of ``to_frame``,
+        where the frame arrives as buffered chunks instead of off a
+        stream reader.  Raises ``ValueError`` on a frame with no header
+        line or a payload shorter than its ``__binlen__`` announcement
+        (a reassembly that lost bytes must surface as a dropped logical
+        frame, never a half-decoded model)."""
+        nl = data.find(b"\n")
+        if nl < 0:
+            raise ValueError("frame has no header line")
+        header = json.loads(data[:nl + 1])
+        # memoryview slices: the multi-MB payload is never copied —
+        # decoded arrays are read-only views into ``data`` (exactly the
+        # stream-reader path's buffer-sharing contract)
+        payload = memoryview(data)[nl + 1:]
+        binlen = header.get(FRAME_BINLEN_KEY) or 0
+        if len(payload) < binlen:
+            raise ValueError(
+                f"frame payload truncated: {len(payload)} < {binlen}"
+            )
+        return cls.from_frame(header, payload[:binlen] if binlen
+                              else b"")
 
     @classmethod
     def from_json(cls, payload: str) -> "Message":

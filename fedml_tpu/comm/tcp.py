@@ -26,18 +26,25 @@ the stale connection is dropped, and other nodes keep flowing.
 from __future__ import annotations
 
 import hashlib
+import itertools
 import json
 import logging
 import queue
 import socket
 import threading
 import time
+import zlib
 from collections import deque
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from fedml_tpu.analysis.locks import assert_held, make_lock
 from fedml_tpu.comm.backend import CommBackend
-from fedml_tpu.comm.message import FRAME_BINLEN_KEY, HUB_KEY, Message
+from fedml_tpu.comm.message import (
+    FRAME_BINLEN_KEY,
+    HUB_KEY,
+    MCAST_STRIPE_KIND,
+    Message,
+)
 from fedml_tpu.obs import trace_ctx
 from fedml_tpu.obs.telemetry import get_telemetry
 
@@ -59,6 +66,37 @@ def _retry_jitter(node_id: int, attempt: int) -> float:
 # on loopback.  The kernel clamps to net.core.{r,w}mem_max — tuning is
 # best-effort by design.
 _TCP_SOCK_BUF = 4 << 20
+
+# Striped-multicast reassembly budget — a shared contract between the
+# two ends: ``TcpBackend`` buffers at most this many payload bytes
+# across its in-progress stripe streams, and ``TcpHub`` refuses to
+# stripe any frame larger than HALF of it (headroom for one
+# interleaved stream) — an over-budget frame falls back to whole-frame
+# fan-out instead of being striped into a guaranteed receiver-side
+# overflow abort on every client.
+_MAX_REASM_BYTES = 64 << 20
+
+
+def _split_traced_mcast(frame: dict, payload: bytes):
+    """For a TRACED mcast, split the inner frame at its header line,
+    stamp ``hub_in``, and return ``(parsed header dict, payload-tail
+    memoryview)``; ``(None, None)`` for untraced frames or an
+    unparseable header (the frame then ships verbatim, unstamped).
+    One definition for the whole-frame and striped fan-out paths — the
+    traced-frame contract must not diverge between them."""
+    if not frame.get(trace_ctx.TRACE_KEY):
+        return None, None
+    hdr = None
+    nl = payload.find(b"\n")
+    if nl >= 0:
+        try:
+            hdr = json.loads(payload[:nl + 1])
+        except json.JSONDecodeError:
+            hdr = None
+    if hdr is None or trace_ctx.TRACE_KEY not in hdr:
+        return None, None
+    trace_ctx.hub_stamp(hdr, "hub_in")
+    return hdr, memoryview(payload)[nl + 1:]
 
 
 def _tune_socket(sock: socket.socket) -> None:
@@ -127,13 +165,25 @@ class _Conn:
     payload tail — the sender worker re-encodes the header line with a
     fresh ``hub_out`` stamp at drain time, so ``hub_out - hub_in`` is
     this frame's real queue wait and the payload bytes are still the
-    one shared immutable object."""
+    one shared immutable object.
 
-    __slots__ = ("sock", "frames", "nbytes", "scheduled")
+    ``heads`` is a strict-priority queue in front of ``frames``: a
+    striped mcast enqueues every receiver's stripe 0 there, and a
+    sender worker always drains heads — across ALL connections — before
+    tail frames, requeuing its connection after the last head so every
+    other connection's pending head drains before any connection's
+    tail.  That is the head-start contract: all K receivers START
+    streaming within one head round instead of the last one waiting
+    behind K-1 whole fan-outs (enqueue order alone cannot guarantee
+    this — tails land while heads are still draining and a paced visit
+    would drain head+tail together)."""
+
+    __slots__ = ("sock", "frames", "heads", "nbytes", "scheduled")
 
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.frames: deque = deque()  # (msg_type, parts, hdr, nbytes)
+        self.heads: deque = deque()  # same entries, strict priority
         self.nbytes = 0
         self.scheduled = False
 
@@ -159,13 +209,30 @@ class TcpHub:
         "backpressure_drops": "_lock",
         "mcast_frames": "_lock",
         "mcast_copies": "_lock",
+        "striped_mcasts": "_lock",
+        "stripe_frames": "_lock",
     }
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  senders: int = 4, max_queue_bytes: int = 256 << 20,
-                 max_queue_frames: int = 4096):
+                 max_queue_frames: int = 4096,
+                 stripe_bytes: int = 0, max_inflight_stripes: int = 8):
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()
+        # striped fan-out: an mcast payload larger than ``stripe_bytes``
+        # (0 = off, ship whole frames) is split into fixed-size
+        # ``__hub__: mcast_stripe`` continuation frames that receivers
+        # reassemble (TcpBackend).  ``max_inflight_stripes`` is the
+        # pacing quantum: a sender-pool worker writes at most that many
+        # frames to ONE connection before rotating to the back of the
+        # ready queue, so all receivers stream concurrently instead of
+        # the last one waiting behind K whole-frame sends — the PR-6
+        # measured fan-out wall (bcast_queue 436.7 ms at 32 clients).
+        self._stripe_bytes = max(0, int(stripe_bytes))
+        self._pace = max(1, int(max_inflight_stripes))
+        self._sid = itertools.count(1)  # process-unique stripe-stream ids
+        self.striped_mcasts = 0
+        self.stripe_frames = 0
         # frames to unregistered/dead receivers are dropped BY DESIGN
         # (the deadline server treats the receiver as a straggler), but
         # invisibly so until now: count them per message type so chaos
@@ -303,25 +370,18 @@ class TcpHub:
                         self.mcast_copies += len(receivers)
                     get_telemetry().inc("hub.mcast_frames",
                                         msg_type=mt or "?")
+                    if (self._stripe_bytes
+                            and len(payload) > self._stripe_bytes
+                            and len(payload) <= _MAX_REASM_BYTES // 2):
+                        self._fan_out_striped(frame, receivers, mt, payload)
+                        continue
                     # traced mcast (outer header flags it): split the
                     # inner frame at its header line ONCE, stamp hub_in,
                     # and queue (parsed header, shared payload-tail
                     # view) per receiver — the sender worker re-encodes
                     # the small header per copy with its own hub_out
                     # stamp while the multi-MB tail stays one object
-                    hdr, tail = None, None
-                    if frame.get(trace_ctx.TRACE_KEY):
-                        nl = payload.find(b"\n")
-                        if nl >= 0:
-                            try:
-                                hdr = json.loads(payload[:nl + 1])
-                            except json.JSONDecodeError:
-                                hdr = None
-                        if hdr is not None and trace_ctx.TRACE_KEY in hdr:
-                            trace_ctx.hub_stamp(hdr, "hub_in")
-                            tail = memoryview(payload)[nl + 1:]
-                        else:
-                            hdr = None
+                    hdr, tail = _split_traced_mcast(frame, payload)
                     for r in receivers:
                         if hdr is not None:
                             self._forward(r, (tail,), msg_type=mt,
@@ -393,7 +453,7 @@ class TcpHub:
             st = self._conns.get(receiver)
             if st is None:
                 dropped = True
-            elif (len(st.frames) >= self._max_queue_frames
+            elif (len(st.frames) + len(st.heads) >= self._max_queue_frames
                     or st.nbytes + nbytes > self._max_queue_bytes):
                 self.backpressure_drops += 1
                 dropped = True
@@ -409,27 +469,164 @@ class TcpHub:
         if wake:
             self._ready.put((receiver, st))
 
+    def _fan_out_striped(self, frame: dict, receivers, mt,
+                         payload: bytes) -> None:
+        """Split one mcast payload into ``mcast_stripe`` frames and
+        enqueue the stripe sequence to every receiver.
+
+        Every stripe is self-describing (its own ``__binlen__`` + a
+        crc32 of its chunk), so the receiver reassembles by simple
+        concatenation and detects a lost/corrupted stripe without
+        trusting stream position.  The chunk buffers are memoryviews
+        over the ONE payload object shared by every receiver's queue —
+        striping adds outer headers, never payload copies.
+
+        Traced frames keep the per-receiver queue-wait measurement:
+        stripe 0 carries the parsed inner header dict instead of bytes,
+        and the sender worker re-encodes that line with a fresh
+        ``hub_out`` stamp at drain time (crc computed then) — exactly
+        the whole-frame traced contract, per copy.
+        """
+        sid = next(self._sid)
+        hdr, tail = _split_traced_mcast(frame, payload)
+        body = tail if hdr is not None else memoryview(payload)
+        chunks = [body[i:i + self._stripe_bytes]
+                  for i in range(0, len(body), self._stripe_bytes)]
+        total = len(chunks) + (1 if hdr is not None else 0)
+        entries: List[tuple] = []
+        if hdr is not None:
+            # deferred stripe 0: (kind, outer meta, inner header dict) —
+            # the worker builds outer line + restamped inner line at
+            # drain.  nbytes is the original line's length (queue
+            # accounting only; the restamp grows it by one hop).
+            meta0 = {"sid": sid, "i": 0, "n": total, "msg_type": mt}
+            entries.append((mt, (), (MCAST_STRIPE_KIND, meta0, hdr),
+                            len(payload) - len(body) + 64))
+        base = 1 if hdr is not None else 0
+        for k, ch in enumerate(chunks):
+            outer = (json.dumps({
+                HUB_KEY: MCAST_STRIPE_KIND, "sid": sid, "i": base + k,
+                "n": total, "msg_type": mt, "crc": zlib.crc32(ch),
+                FRAME_BINLEN_KEY: len(ch),
+            }) + "\n").encode()
+            entries.append((mt, (outer, ch), None, len(outer) + len(ch)))
+        with self._lock:
+            self.striped_mcasts += 1
+        # head-start scheduling: EVERY receiver's stripe 0 rides the
+        # strict-priority head queue, so the pool drains all K head
+        # stripes (small) before any tail — every receiver starts
+        # streaming within one head round (bcast_queue ≈ that round,
+        # not K-1 whole-frame sends) — and then drains tails at
+        # ``max_inflight_stripes`` locality, which staggers COMPLETION
+        # times so receivers that finish early overlap their downstream
+        # work with the rest of the fan-out (measured: full round-robin
+        # equalizes completion and serializes the cohort's post-receive
+        # compute AFTER the fan-out window; see PROFILE.md round-9).
+        for r in receivers:
+            self._forward_stripes(r, entries[:1], mt, head=True)
+        for r in receivers:
+            self._forward_stripes(r, entries[1:], mt)
+
+    def _forward_stripes(self, receiver: int, entries: List[tuple],
+                         msg_type, head: bool = False) -> None:
+        """Enqueue one segment of a logical frame's stripe sequence
+        atomically (all or nothing): an over-bound queue drops the
+        whole segment in one counted decision — the receiver then sees
+        an index gap (tail dropped after its head) or nothing at all,
+        and either way the logical frame dies with straggler semantics
+        instead of wedging reassembly (a gap aborts the stream; a
+        head with no tail is evicted by the bounded-stream cap)."""
+        nbytes = sum(e[3] for e in entries)
+        wake = False
+        dropped = False
+        with self._lock:
+            st = self._conns.get(receiver)
+            if st is None:
+                dropped = True
+            elif (len(st.frames) + len(st.heads) + len(entries)
+                    > self._max_queue_frames
+                    or st.nbytes + nbytes > self._max_queue_bytes):
+                self.backpressure_drops += 1
+                dropped = True
+            else:
+                (st.heads if head else st.frames).extend(entries)
+                st.nbytes += nbytes
+                self.stripe_frames += len(entries)
+                if not st.scheduled:
+                    st.scheduled = True
+                    wake = True
+        if dropped:
+            self._count_drop(receiver, msg_type)
+            return
+        if wake:
+            self._ready.put((receiver, st))
+
     def _sender_loop(self):
         """Sender-pool worker: drain the one connection handed to it.
         A worker only ever services the exact ``_Conn`` it was
         scheduled for (never a same-id replacement), so a reconnecting
-        node can't end up with two drainers interleaving its stream."""
+        node can't end up with two drainers interleaving its stream.
+
+        Pacing: after ``max_inflight_stripes`` frames to one conn the
+        worker re-queues it at the BACK of the ready queue and moves
+        on (``scheduled`` stays True, so there is still exactly one
+        drainer).  Combined with the head-start enqueue order
+        (``_fan_out_striped``) this bounds how long any receiver's
+        stream can monopolize a worker: small pace = round-robin fair
+        streaming (equal completion), large pace = locality draining
+        (staggered completion, better when receivers share cores with
+        the hub).
+        """
         while True:
             item = self._ready.get()
             if item is None:
                 return
             nid, st = item
+            quantum = 0
             while True:
+                requeue = False
+                from_head = False
                 with self._lock:
                     if self._conns.get(nid) is not st:
                         break  # replaced/deregistered: frames die with it
-                    if not st.frames:
+                    if st.heads:
+                        # strict priority, quantum-exempt: heads are
+                        # small and the head-start contract wants all
+                        # of them out before any conn's tail
+                        msg_type, parts, hdr, nbytes = st.heads.popleft()
+                        st.nbytes -= nbytes
+                        from_head = True
+                    elif not st.frames:
                         st.scheduled = False
                         break
-                    msg_type, parts, hdr, nbytes = st.frames.popleft()
-                    st.nbytes -= nbytes
+                    elif quantum >= self._pace:
+                        requeue = True
+                    else:
+                        msg_type, parts, hdr, nbytes = st.frames.popleft()
+                        st.nbytes -= nbytes
+                if requeue:
+                    self._ready.put((nid, st))
+                    break
+                # a head send exhausts the visit's quantum: the worker
+                # requeues before touching this conn's TAIL, so every
+                # other conn's pending head drains first (the requeue
+                # lands behind them in the FIFO ready queue)
+                quantum = self._pace if from_head else quantum + 1
                 try:
-                    if hdr is not None:
+                    if isinstance(hdr, tuple):
+                        # deferred traced stripe 0: build the outer
+                        # stripe header + the inner header line with
+                        # THIS copy's hub_out stamp, crc over the line
+                        # actually sent
+                        _, meta, inner_hdr = hdr
+                        line = trace_ctx.hub_out_line(inner_hdr)
+                        outer = (json.dumps({
+                            HUB_KEY: MCAST_STRIPE_KIND, **meta,
+                            "crc": zlib.crc32(line),
+                            FRAME_BINLEN_KEY: len(line),
+                        }) + "\n").encode()
+                        _sendall_parts(st.sock, [outer, line])
+                    elif hdr is not None:
                         # traced frame: re-encode the (small) header
                         # line with THIS copy's hub_out stamp at drain
                         # time — hub_out - hub_in is this receiver's
@@ -448,7 +645,9 @@ class TcpHub:
                     with self._lock:
                         if self._conns.get(nid) is st:
                             self._conns.pop(nid, None)
-                        leftovers = [e[0] for e in st.frames]
+                        leftovers = [e[0] for e in st.heads]
+                        leftovers += [e[0] for e in st.frames]
+                        st.heads.clear()
                         st.frames.clear()
                         st.nbytes = 0
                     for mt in leftovers:
@@ -483,6 +682,8 @@ class TcpHub:
                 "backpressure_drops": self.backpressure_drops,
                 "mcast_frames": self.mcast_frames,
                 "mcast_copies": self.mcast_copies,
+                "striped_mcasts": self.striped_mcasts,
+                "stripe_frames": self.stripe_frames,
             }
 
     def sample_telemetry(self, telemetry=None) -> dict:
@@ -497,13 +698,15 @@ class TcpHub:
         per-frame hop stamps, which share this clock."""
         t = telemetry or get_telemetry()
         with self._lock:
-            depths = {nid: (len(st.frames), st.nbytes)
+            depths = {nid: (len(st.frames) + len(st.heads), st.nbytes)
                       for nid, st in self._conns.items()}
             snap = {
                 "dropped_frames": dict(self.dropped_frames),
                 "backpressure_drops": self.backpressure_drops,
                 "mcast_frames": self.mcast_frames,
                 "mcast_copies": self.mcast_copies,
+                "striped_mcasts": self.striped_mcasts,
+                "stripe_frames": self.stripe_frames,
             }
         for nid, (nframes, nbytes) in depths.items():
             t.gauge_set("hub.send_queue_frames", nframes, node=nid)
@@ -517,6 +720,7 @@ class TcpHub:
         t.gauge_set("hub.backpressure_drops_total",
                     snap["backpressure_drops"])
         t.gauge_set("hub.mcast_frames_total", snap["mcast_frames"])
+        t.gauge_set("hub.stripe_frames_total", snap["stripe_frames"])
         t.event(
             "hub_stats", t_m=trace_ctx.now(),
             connections=sorted(depths),
@@ -550,7 +754,34 @@ class TcpBackend(CommBackend):
     while disconnected are lost — by design the round-deadline server
     (``fedavg_cross_device``) treats the node as a straggler for that
     round and it rejoins at the next sync.
+
+    Striped multicast reassembly: a hub running striped fan-out delivers
+    a broadcast as ``__hub__: mcast_stripe`` frames; this backend
+    reassembles them per stripe-stream id into the original inner frame
+    and delivers it like any whole frame (``_on_stripe``).  The buffer
+    is bounded (streams + bytes) and a lost/corrupted stripe kills the
+    WHOLE logical frame — counted, never a wedged reassembly: the round
+    deadline treats the node as a straggler exactly as for a dropped
+    whole frame.
     """
+
+    # lock-discipline contract (fedlint): the reader thread owns
+    # reassembly, but the chaos layer installs its stripe hook from the
+    # construction thread — all reassembly state rides one lock
+    _GUARDED_BY = {
+        "_reasm": "_reasm_lock",
+        "_reasm_bytes": "_reasm_lock",
+        "_dead_sids": "_reasm_lock",
+        "_stripe_fault_hook": "_reasm_lock",
+    }
+
+    # bounded reassembly: at most this many concurrent stripe streams
+    # (two mcasts can interleave on one conn; more means lost finals
+    # piling up) and this many buffered payload bytes (the hub refuses
+    # to stripe frames over HALF this shared budget — see the module
+    # constant — so a well-formed stream can never overflow it alone)
+    _MAX_REASM_STREAMS = 8
+    _MAX_REASM_BYTES = _MAX_REASM_BYTES
 
     def __init__(self, node_id: int, host: str, port: int,
                  timeout: float = 30.0, auto_reconnect: int = 0,
@@ -574,7 +805,23 @@ class TcpBackend(CommBackend):
         # lands BEFORE the registration line and the hub parses the
         # message frame as the hello (KeyError, conn dropped, frame lost)
         self._send_lock = make_lock("TcpBackend._send_lock")
+        # striped-multicast reassembly (see class doc): sid -> entry
+        self._reasm_lock = make_lock("TcpBackend._reasm_lock")
+        self._reasm: Dict[int, dict] = {}
+        self._reasm_bytes = 0
+        self._dead_sids: deque = deque(maxlen=64)  # aborted stream ids
+        self._stripe_fault_hook = None
         self._dial()
+
+    def set_stripe_fault_hook(self, hook) -> None:
+        """Install a per-stripe fault hook (chaos layer):
+        ``hook(msg_type, sid, idx, chunk) -> chunk | None`` runs on
+        every arriving stripe before crc verification — ``None``
+        simulates a lost stripe, a mutated chunk a corrupted one (the
+        crc then catches it).  The reassembly must degrade to a dropped
+        logical frame either way."""
+        with self._reasm_lock:
+            self._stripe_fault_hook = hook
 
     def _dial(self):
         with self._send_lock:
@@ -916,6 +1163,16 @@ class TcpBackend(CommBackend):
                     continue  # retry until the budget runs out
             if frame.get(HUB_KEY) == "stop":
                 return
+            if frame.get(HUB_KEY) == MCAST_STRIPE_KIND:
+                try:
+                    self._on_stripe(frame, payload,
+                                    nbytes=len(line) + len(payload))
+                except Exception:
+                    # reassembly bugs must degrade to a dropped logical
+                    # frame (straggler semantics), never a dead reader
+                    logging.exception("node %d: stripe reassembly failed",
+                                      self.node_id)
+                continue
             try:
                 # exact wire bytes: header line + binary payload
                 self._notify(Message.from_frame(frame, payload),
@@ -925,6 +1182,126 @@ class TcpBackend(CommBackend):
                 # node would silently stop receiving and the federation
                 # would hang with no attributable cause
                 logging.exception("node %d: message handler failed", self.node_id)
+
+    def _on_stripe(self, frame: dict, chunk: bytes, nbytes: int) -> None:
+        """One ``mcast_stripe`` continuation frame off the wire.
+
+        Stripes of one logical frame arrive in order (per-conn FIFO +
+        single drainer), so reassembly is append-only per stream id; an
+        index gap means an upstream drop (hub backpressure, chaos) and
+        kills the whole stream.  crc32 mismatch = corrupted stripe,
+        same fate.  Completion hands the concatenated inner frame to
+        ``_notify`` exactly like a whole frame — with a backdated
+        ``reasm`` hop stamp (first-stripe arrival) so the timeline can
+        split fan-out delivery from reassembly wait.
+        """
+        sid, idx = frame.get("sid"), frame.get("i")
+        total = frame.get("n")
+        mt = frame.get("msg_type") or "?"
+        t_now = time.perf_counter()
+        with self._reasm_lock:
+            hook = self._stripe_fault_hook
+        if hook is not None:
+            chunk = hook(mt, sid, idx, chunk)
+            if chunk is None:
+                return  # injected loss: the gap aborts the stream later
+        tel = get_telemetry()
+        tel.inc("comm.stripe_frames", msg_type=mt)
+        abort_reason = None
+        done = None
+        with self._reasm_lock:
+            if sid in self._dead_sids:
+                return  # already-aborted stream: ignore the tail
+            ent = self._reasm.get(sid)
+            if ent is None:
+                if idx != 0:
+                    abort_reason = "gap"  # head stripe was lost upstream
+                elif len(self._reasm) >= self._MAX_REASM_STREAMS:
+                    # evict the OLDEST stream (its final stripe is
+                    # presumed lost) to admit the new one
+                    oldest = None
+                    for s, e in self._reasm.items():
+                        if oldest is None or e["t0"] < oldest_t0:
+                            oldest, oldest_t0 = s, e["t0"]
+                    dead = self._reasm.pop(oldest)
+                    self._reasm_bytes -= dead["blen"]
+                    self._dead_sids.append(oldest)
+                    tel.inc("comm.stripe_aborts", reason="stale",
+                            msg_type=dead["mt"])
+                ent = None
+            if abort_reason is None:
+                if ent is None:
+                    ent = {"chunks": [], "next": 0, "total": total,
+                           "t0": t_now, "nbytes": 0, "blen": 0, "mt": mt}
+                    self._reasm[sid] = ent
+                if idx != ent["next"] or total != ent["total"]:
+                    abort_reason = "gap"
+                elif zlib.crc32(chunk) != frame.get("crc"):
+                    abort_reason = "crc"
+                else:
+                    while (self._reasm_bytes + len(chunk)
+                            > self._MAX_REASM_BYTES
+                            and len(self._reasm) > 1):
+                        # the budget is hogged by OLDER partial streams
+                        # whose finals are presumed lost (e.g. a hub
+                        # reconnect killed their tails mid-broadcast):
+                        # evict oldest-first so stale bytes can never
+                        # permanently starve every later broadcast —
+                        # the live stream wins
+                        oldest = None
+                        for s, e in self._reasm.items():
+                            if s != sid and (oldest is None
+                                             or e["t0"] < oldest_t0):
+                                oldest, oldest_t0 = s, e["t0"]
+                        if oldest is None:
+                            break
+                        dead = self._reasm.pop(oldest)
+                        self._reasm_bytes -= dead["blen"]
+                        self._dead_sids.append(oldest)
+                        tel.inc("comm.stripe_aborts", reason="stale",
+                                msg_type=dead["mt"])
+                    if (self._reasm_bytes + len(chunk)
+                            > self._MAX_REASM_BYTES):
+                        abort_reason = "overflow"
+                if abort_reason is None:
+                    ent["chunks"].append(chunk)
+                    ent["next"] += 1
+                    ent["nbytes"] += nbytes
+                    ent["blen"] += len(chunk)
+                    self._reasm_bytes += len(chunk)
+                    if ent["next"] == ent["total"]:
+                        del self._reasm[sid]
+                        self._reasm_bytes -= ent["blen"]
+                        done = ent
+            if abort_reason is not None:
+                dead = self._reasm.pop(sid, None)
+                if dead is not None:
+                    self._reasm_bytes -= dead["blen"]
+                self._dead_sids.append(sid)
+        if abort_reason is not None:
+            tel.inc("comm.stripe_aborts", reason=abort_reason, msg_type=mt)
+            logging.warning(
+                "node %d: striped frame sid=%s (%s) aborted at stripe "
+                "%s: %s — logical frame dropped", self.node_id, sid, mt,
+                idx, abort_reason,
+            )
+            return
+        if done is None:
+            return
+        try:
+            msg = Message.from_frame_bytes(b"".join(done["chunks"]))
+        except Exception:
+            tel.inc("comm.stripe_aborts", reason="undecodable", msg_type=mt)
+            logging.warning(
+                "node %d: reassembled frame sid=%s (%s) undecodable — "
+                "dropped", self.node_id, sid, mt,
+            )
+            return
+        tel.inc("comm.stripe_reassemblies", msg_type=mt)
+        # backdated hop: reassembly started at first-stripe arrival —
+        # recv - reasm is the reassembly/streaming wait on this node
+        trace_ctx.stamp_msg(msg, self.node_id, "reasm", t=done["t0"])
+        self._notify(msg, nbytes=done["nbytes"])
 
     def run_in_thread(self) -> threading.Thread:
         t = threading.Thread(target=self.run, daemon=True)

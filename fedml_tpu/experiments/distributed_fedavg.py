@@ -169,10 +169,20 @@ def _start_event_flusher(mlog, interval: float = 1.0):
 
 
 def run_hub(host: str, port: int, run_dir: str = "",
-            stats_interval: float = 1.0) -> None:
+            stats_interval: float = 1.0, fanout: str = "striped",
+            stripe_kib: int = 256, stripe_pace: int = 8) -> None:
     from fedml_tpu.comm.tcp import TcpHub
 
-    hub = TcpHub(host, port)
+    # striped fan-out is the DEFAULT hub mode: multicast payloads split
+    # into fixed-size crc'd stripes, every receiver's stripe 0
+    # head-started before any tail, so the last of K receivers no
+    # longer waits behind K-1 whole-frame sends to START receiving
+    # (the PR-6-measured bcast_queue wall).  --fanout whole restores
+    # the PR-5 whole-frame behavior — the measurement baseline arm.
+    hub = TcpHub(host, port,
+                 stripe_bytes=(stripe_kib << 10) if fanout == "striped"
+                 else 0,
+                 max_inflight_stripes=stripe_pace)
     # announce the bound port on stdout for the launcher
     print(json.dumps({"hub_port": hub.port}), flush=True)
     stop = {"flag": False}
@@ -250,6 +260,10 @@ def run_server(args) -> None:
         codec=args.codec,
         multicast=args.hotpath == "fast",
         streaming_agg=args.hotpath == "fast",
+        # decode/fold pipeline + double-buffered broadcast encode: fast
+        # hotpath only (the legacy arm is the fully serial baseline)
+        decode_workers=(args.decode_workers
+                        if args.hotpath == "fast" else 0),
     )
     # startup barrier: the hub drops frames to unregistered receivers,
     # so broadcasting before every client registered would hang
@@ -386,6 +400,10 @@ def launch(
     wire: int = 2,
     input_dim: int = 8,
     hotpath: str = "fast",
+    fanout: str = "striped",
+    stripe_kib: int = 256,
+    stripe_pace: int = 8,
+    decode_workers: int = 2,
     train_samples: int = 60,
     run_dir: str = "",
     trace: bool = False,
@@ -447,8 +465,11 @@ def launch(
     procs = []
     killed_registered_peer = False
     try:
+        hub_flags = rd_flags + ["--fanout", fanout,
+                                "--stripe-kib", str(stripe_kib),
+                                "--stripe-pace", str(stripe_pace)]
         hub = subprocess.Popen(
-            me + ["--role", "hub", "--port", "0"] + rd_flags,
+            me + ["--role", "hub", "--port", "0"] + hub_flags,
             stdout=subprocess.PIPE, text=True, env=env,
         )
         hubs.append(hub)
@@ -468,6 +489,8 @@ def launch(
             common += ["--input-dim", str(input_dim)]
         if hotpath != "fast":
             common += ["--hotpath", hotpath]
+        if decode_workers != 2:
+            common += ["--decode-workers", str(decode_workers)]
         if train_samples != 60:
             common += ["--train-samples", str(train_samples)]
         if round_timeout:
@@ -535,7 +558,7 @@ def launch(
             hub.wait(timeout=10)
             time.sleep(0.5)  # a beat of real downtime
             hub = subprocess.Popen(
-                me + ["--role", "hub", "--port", str(port)] + rd_flags,
+                me + ["--role", "hub", "--port", str(port)] + hub_flags,
                 stdout=subprocess.PIPE, text=True, env=env,
             )
             hubs.append(hub)
@@ -637,6 +660,19 @@ def main(argv=None):
     # their node id); --train-samples scales per-client local compute
     # so latency runs can pick a comm-dominant regime
     p.add_argument("--hotpath", choices=["fast", "legacy"], default="fast")
+    # fan-out/pipeline knobs: --fanout striped splits hub multicast
+    # payloads into --stripe-kib KiB crc'd stripes, head-starts every
+    # receiver's stripe 0, then drains tails at --stripe-pace frames
+    # per connection per drain quantum (small pace = fair round-robin
+    # streaming, large = staggered-completion locality; whole = the
+    # PR-5 whole-frame baseline); --decode-workers sizes the server's
+    # off-reader-thread upload decode pool (0 = serial decode on the
+    # reader thread, the pre-pipeline behavior)
+    p.add_argument("--fanout", choices=["striped", "whole"],
+                   default="striped")
+    p.add_argument("--stripe-kib", type=int, default=256)
+    p.add_argument("--stripe-pace", type=int, default=8)
+    p.add_argument("--decode-workers", type=int, default=2)
     p.add_argument("--train-samples", type=int, default=60)
     # observability knobs: --run-dir makes EVERY process (hub included)
     # append its telemetry registry to its own metrics-<tag>.jsonl in
@@ -651,7 +687,9 @@ def main(argv=None):
         # before any comm import reads (and caches) the switch
         os.environ["FEDML_TPU_TRACE"] = "1"
     if args.role == "hub":
-        run_hub(args.host, args.port, args.run_dir, args.stats_interval)
+        run_hub(args.host, args.port, args.run_dir, args.stats_interval,
+                fanout=args.fanout, stripe_kib=args.stripe_kib,
+                stripe_pace=args.stripe_pace)
     elif args.role == "server":
         run_server(args)
     else:

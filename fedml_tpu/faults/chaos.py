@@ -153,6 +153,14 @@ class ChaosBackend(CommBackend):
         self._deterministic = bus is not None
         if bus is not None and hasattr(bus, "add_quiesce_hook"):
             bus.add_quiesce_hook(self.flush_held)
+        # stripe-level faults (direction="stripe" rules): install the
+        # per-stripe hook on the inner transport's reassembly path —
+        # a dropped stripe becomes an index gap, a corrupted one a crc
+        # mismatch, and EITHER kills the whole logical frame without
+        # wedging reassembly (the TcpBackend contract this exercises).
+        # Transports without striping accept the hook as a no-op.
+        if any(r.direction == "stripe" for r in plan.rules):
+            inner.set_stripe_fault_hook(self._stripe_fault)
         inner.add_observer(_Bridge(self))
 
     # -- fault application --------------------------------------------------
@@ -184,6 +192,28 @@ class ChaosBackend(CommBackend):
 
     def _inject(self, action: str, msg_type: str) -> None:
         self.telemetry.inc("faults.injected", action=action, msg_type=msg_type)
+
+    def _stripe_fault(self, msg_type: str, sid, idx, chunk):
+        """Per-stripe decision on the inner transport's reassembly path
+        (see ``TcpBackend.set_stripe_fault_hook``): returns ``None`` to
+        swallow the stripe (the reassembler sees a gap) or the —
+        possibly corrupted — chunk.  Decisions ride the same seeded
+        per-(direction, msg_type) sequence stream and the same pinned
+        trace as message-level faults."""
+        if not self.plan.applies_to(msg_type):
+            return chunk
+        _seq, acts = self._decide_traced("stripe", msg_type, None)
+        for a in acts:
+            if a["action"] == "drop":
+                self._inject("drop_stripe", msg_type)
+                return None
+            if a["action"] == "corrupt":
+                self._inject("corrupt_stripe", msg_type)
+                bad = bytearray(chunk)
+                if bad:
+                    bad[0] ^= 0xFF  # any bit flip: the crc32 must catch it
+                chunk = bytes(bad)
+        return chunk
 
     def _apply(self, direction: str, msg: Message,
                forward: Callable[[Message], None], receiver=None) -> None:

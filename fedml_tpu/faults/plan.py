@@ -103,8 +103,25 @@ class FaultRule:
             raise ValueError(
                 f"unknown fault action {self.action!r} (one of {ACTIONS})"
             )
-        if self.direction not in ("send", "recv"):
-            raise ValueError(f"direction must be send|recv: {self.direction!r}")
+        if self.direction not in ("send", "recv", "stripe"):
+            raise ValueError(
+                f"direction must be send|recv|stripe: {self.direction!r}"
+            )
+        if self.direction == "stripe" and self.action not in ("drop",
+                                                              "corrupt"):
+            # a stripe is a wire fragment: it can be lost or garbled,
+            # but delay/duplicate/reorder/disconnect are whole-message
+            # semantics — at stripe granularity they would only model
+            # transports TCP cannot be (the stream is ordered)
+            raise ValueError(
+                f"stripe faults support drop|corrupt only: {self.action!r}"
+            )
+        if self.direction == "stripe" and self.round is not None:
+            raise ValueError(
+                "stripe rules cannot filter by round: the stripe hook "
+                "runs before the inner frame (and its round_idx) is "
+                "reassembled"
+            )
 
     def matches(self, node, direction, msg_type, round_idx,
                 receiver=None) -> bool:
@@ -192,7 +209,12 @@ class FaultPlan:
                     "delay_msgs": rule.delay_msgs,
                     "delay_s": rule.delay_s,
                 })
-        spec = self.send_spec if direction == "send" else self.recv_spec
+        # the probabilistic mixes model whole-message faults — stripe
+        # decisions come from explicit stripe rules only
+        if direction == "stripe":
+            spec = None
+        else:
+            spec = self.send_spec if direction == "send" else self.recv_spec
         # the probabilistic mix stays inside msg_types even when an
         # explicit rule admitted this type past applies_to
         spec_applies = self.msg_types is None or msg_type in self.msg_types

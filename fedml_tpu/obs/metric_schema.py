@@ -34,6 +34,9 @@ COUNTERS = {
     "comm.reconnects": "hub re-dials by the auto-reconnect path",
     "comm.mcast_sends": "native multicast frames sent {msg_type=}",
     "comm.mcast_receivers": "receivers addressed by multicast frames {msg_type=}",
+    "comm.stripe_frames": "mcast_stripe continuation frames received {msg_type=}",
+    "comm.stripe_reassemblies": "striped logical frames reassembled + delivered {msg_type=}",
+    "comm.stripe_aborts": "striped logical frames killed (gap/crc/overflow/stale/undecodable) {reason=,msg_type=}",
     "hub.mcast_frames": "mcast control frames fanned out by the hub {msg_type=}",
     "hub.dropped_frames": "frames to unregistered/dead/over-bound receivers {msg_type=}",
     "faults.injected": "chaos-layer injections {action=,msg_type=}",
@@ -50,6 +53,7 @@ GAUGES = {
     "hub.send_queue_bytes": "per-connection outbound queue bytes {node=}",
     "hub.backpressure_drops_total": "cumulative over-bound queue drops",
     "hub.mcast_frames_total": "cumulative mcast frames (time series form)",
+    "hub.stripe_frames_total": "cumulative enqueued mcast stripes (time series form)",
     "jax.device_mem_bytes": "device memory in use {device=}",
     "jax.device_mem_peak_bytes": "high-water device memory {device=}",
     "clock.hub_offset_s": "estimated monotonic-clock offset to the hub {node=}",
@@ -61,6 +65,9 @@ HISTOGRAMS = {
     "comm.send_latency_s": "time inside send_message (serialize + write) {msg_type=}",
     "comm.handle_latency_s": "NodeManager handler time {msg_type=}",
     "span.agg_fold_s": "per-arrival streaming-aggregation fold",
+    "span.decode_wait_s": "upload decode queue wait, reader submit -> pool pickup",
+    "span.decode_s": "upload decode + finite-firewall scan (off the reader thread)",
+    "span.encode_overlap_s": "next broadcast's off-thread encode+send span",
     "span.agg_s": "close-time aggregation (buffered mode / normalize)",
     "span.server_round_s": "server round wall time, open to close",
     "span.reconnect_s": "outage span, first EOF to re-registered",

@@ -138,15 +138,17 @@ def ensure(msg, origin: int) -> None:
     msg.add_params(TRACE_KEY, new_ctx(origin, msg.get("round_idx")))
 
 
-def stamp_msg(msg, node, event: str) -> None:
+def stamp_msg(msg, node, event: str, t: Optional[float] = None) -> None:
     """Stamp a decoded/in-process message (inproc send, backend recv).
     Assigns directly into ``msg.params`` — deliberately NOT through
     ``add_params``: a hop stamp is header-only metadata and must not
     invalidate a memoized frame encoding (the tcp path restamps the
-    header line instead of re-encoding the payload)."""
+    header line instead of re-encoding the payload).  ``t`` backdates
+    the stamp (stripe reassembly stamps first-stripe arrival when the
+    frame finally decodes)."""
     ctx = msg.params.get(TRACE_KEY)
     if ctx is not None:
-        msg.params[TRACE_KEY] = stamp_ctx(ctx, node, event)
+        msg.params[TRACE_KEY] = stamp_ctx(ctx, node, event, t)
 
 
 def fork_copy(msg):

@@ -1,0 +1,46 @@
+"""Version-tolerant jax API surface for the parallel/ package.
+
+The repo targets the modern ``jax.shard_map`` entry point (typed-vma
+era: ``check_vma=`` kwarg), but supported build environments pin back
+to jax 0.4.x where the transform only exists as
+``jax.experimental.shard_map.shard_map`` and the same knob is spelled
+``check_rep=``.  Every shard_map call site in the package imports the
+transform from HERE so the whole spmd/ring/pipeline/expert family runs
+on either generation instead of dying with AttributeError at import.
+
+The wrapper keeps the modern calling convention: pass ``check_vma=``
+and it is forwarded verbatim on new jax and translated to
+``check_rep=`` on old jax (the two knobs gate the same replication /
+varying-manual-axes check, renamed across the migration).
+"""
+
+from __future__ import annotations
+
+import jax
+
+_NATIVE = getattr(jax, "shard_map", None)
+if _NATIVE is None:
+    from jax.experimental.shard_map import shard_map as _EXPERIMENTAL
+else:
+    _EXPERIMENTAL = None
+
+# jax.enable_x64 was promoted out of jax.experimental on the same
+# migration; core/mpc.py's finite-field arithmetic needs it under
+# either name
+enable_x64 = getattr(jax, "enable_x64", None)
+if enable_x64 is None:
+    from jax.experimental import enable_x64  # noqa: F401
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map`` on new jax, ``jax.experimental.shard_map`` on
+    0.4.x — one modern signature for both (see module doc)."""
+    if _NATIVE is not None:
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+        return _NATIVE(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kwargs)
+    if check_vma is not None:
+        kwargs["check_rep"] = check_vma
+    return _EXPERIMENTAL(f, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, **kwargs)

@@ -43,6 +43,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fedml_tpu.parallel.compat import shard_map
 from fedml_tpu.algorithms.fedavg import ServerState, make_round_fn
 from fedml_tpu.core.client import make_local_update
 from fedml_tpu.core.losses import LossFn, masked_softmax_ce
@@ -195,7 +196,7 @@ def make_dp_sp_round_fn(
     inner = make_round_fn(local_update, axis_name="clients")
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             P(),                          # state replicated

@@ -24,6 +24,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fedml_tpu.parallel.compat import shard_map
+
 PyTree = Any
 
 
@@ -105,7 +107,7 @@ def make_moe_ffn(mesh: Mesh, capacity: int, axis: str = "ep"):
         return out * gate[:, None]
 
     param_specs = {"gate": P(), "w_in": P(axis), "w_out": P(axis)}
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local, mesh=mesh, in_specs=(param_specs, P(axis)), out_specs=P(axis),
         check_vma=False,
     )

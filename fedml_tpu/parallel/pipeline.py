@@ -28,6 +28,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fedml_tpu.parallel.compat import shard_map
+
 PyTree = Any
 
 # stage_fn(stage_params, x[B, ...]) -> y[B, ...]  (same activation shape
@@ -100,7 +102,7 @@ def make_gpipe(mesh: Mesh, stage_fn: StageFn, axis: str = "pp"):
         )
         return out
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         local, mesh=mesh, in_specs=(P(axis), P()), out_specs=P(),
         check_vma=False,
     )

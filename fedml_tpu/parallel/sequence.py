@@ -21,7 +21,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+from fedml_tpu.parallel.compat import shard_map
 
 from fedml_tpu.models.transformer import TransformerLM
 from fedml_tpu.parallel.ring_attention import (ring_attention,

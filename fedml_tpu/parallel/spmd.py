@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from fedml_tpu.parallel.compat import shard_map
 from fedml_tpu.algorithms.fedavg import make_round_fn
 from fedml_tpu.core.client import LocalUpdateFn
 
@@ -79,7 +80,7 @@ def make_spmd_round_fn(
     )
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             P(),  # state replicated
@@ -317,7 +318,7 @@ def make_hierarchical_spmd_round_fn(
     from fedml_tpu.algorithms.fedavg import ServerState
 
     @partial(
-        jax.shard_map,
+        shard_map,
         mesh=mesh,
         in_specs=(
             P(),                      # state replicated

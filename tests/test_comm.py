@@ -715,3 +715,396 @@ def test_trace_hops_end_to_end_over_hub():
         hub.stop()
         trace_ctx.set_enabled(None)
         get_telemetry().drain_events()
+
+
+# --- striped fan-out + decode/fold pipeline (ISSUE 8) -----------------------
+
+
+def _hub_federation(*, stripe_bytes, decode_workers, codec="none", seed=1,
+                    rounds=3, num_clients=3, input_dim=64):
+    """One in-process federation over a real TcpHub; returns (final
+    model leaf bytes, per-client upload digests, hub stats)."""
+    ds = synthetic_classification(
+        num_train=120, num_test=30, input_shape=(input_dim,),
+        num_classes=2, num_clients=num_clients, partition="homo", seed=seed,
+    )
+    bundle = logistic_regression(input_dim, 2)
+    init = bundle.init(jax.random.PRNGKey(seed))
+    lu = make_local_update(bundle, make_client_optimizer("sgd", 0.1), 1)
+    hub = TcpHub(stripe_bytes=stripe_bytes, max_inflight_stripes=2)
+    sb = TcpBackend(0, hub.host, hub.port)
+    cbs = [TcpBackend(i + 1, hub.host, hub.port) for i in range(num_clients)]
+    server = FedAvgServerManager(
+        sb, init, num_clients=num_clients, clients_per_round=num_clients,
+        comm_rounds=rounds, seed=seed, codec=codec,
+        decode_workers=decode_workers,
+    )
+    clients = [
+        FedAvgClientManager(cb, lu, ds, batch_size=16,
+                            template_variables=init, seed=seed)
+        for cb in cbs
+    ]
+    threads = [cb.run_in_thread() for cb in cbs]
+    st = sb.run_in_thread()
+    server.start()
+    st.join(timeout=90)
+    assert not st.is_alive(), "server did not finish"
+    for t in threads:
+        t.join(timeout=15)
+    stats = hub.stats()
+    hub.stop()
+    assert server.round_idx == rounds
+    leaves = [np.asarray(l).tobytes()
+              for l in jax.tree_util.tree_leaves(server.variables)]
+    return leaves, [c.upload_digest for c in clients], stats
+
+
+def test_striped_multicast_reassembles_byte_identical():
+    """A striped mcast reaches every receiver byte-identical to the
+    whole frame: stripes carry crcs, receivers reassemble, and the
+    payload is still shipped to the hub exactly once."""
+    import time
+
+    from fedml_tpu.obs.telemetry import get_telemetry
+
+    hub = TcpHub(stripe_bytes=64 << 10, max_inflight_stripes=2)
+    got = {1: [], 2: [], 3: []}
+
+    class Obs:
+        def __init__(self, i):
+            self.i = i
+
+        def receive_message(self, t, m):
+            got[self.i].append(m)
+
+    receivers = []
+    for i in (1, 2, 3):
+        b = TcpBackend(i, hub.host, hub.port)
+        b.add_observer(Obs(i))
+        b.run_in_thread()
+        receivers.append(b)
+    sender = TcpBackend(9, hub.host, hub.port)
+    sender.await_peers([1, 2, 3])
+    payload = np.arange(300_000, dtype=np.float32)  # 1.2 MB -> 19 stripes
+    m = Message("MCAST_PIN", 9, -1)
+    m.add_params("model", payload)
+    before = get_telemetry().snapshot()["counters"]
+    sender.send_multicast(m, [1, 2, 3])
+    deadline = time.monotonic() + 15
+    while any(not got[i] for i in (1, 2, 3)) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    for i in (1, 2, 3):
+        assert got[i], f"node {i} never received the striped multicast"
+        np.testing.assert_array_equal(np.asarray(got[i][0].get("model")),
+                                      payload)
+    after = get_telemetry().snapshot()["counters"]
+    key = "comm.sent_bytes{msg_type=MCAST_PIN}"
+    # encode-once broadcast still holds: ONE payload to the hub
+    assert payload.nbytes <= after.get(key, 0) - before.get(key, 0) \
+        < 2 * payload.nbytes
+    stats = hub.stats()
+    n_stripes = -(-payload.nbytes // (64 << 10)) + 1  # chunks + header pad
+    assert stats["striped_mcasts"] == 1
+    # 3 receivers x ceil(payload/stripe) frames (untraced: no extra
+    # header stripe)
+    assert stats["stripe_frames"] == 3 * (n_stripes - 1) \
+        or stats["stripe_frames"] == 3 * n_stripes
+    key = "comm.stripe_reassemblies{msg_type=MCAST_PIN}"
+    assert after.get(key, 0) - before.get(key, 0) == 3
+    for b in receivers:
+        b.stop()
+    sender.stop()
+    hub.stop()
+
+
+def _stripe_fault_rig(hook):
+    """One sender -> hub(striped) -> one hooked receiver; returns
+    (send(msg_type, nbytes), got list, closer)."""
+    import time
+
+    hub = TcpHub(stripe_bytes=16 << 10, max_inflight_stripes=2)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+
+    recv = TcpBackend(1, hub.host, hub.port)
+    recv.add_observer(Obs())
+    recv.set_stripe_fault_hook(hook)
+    recv.run_in_thread()
+    sender = TcpBackend(2, hub.host, hub.port)
+    sender.await_peers([1])
+
+    def send(tag, nfloats):
+        m = Message(tag, 2, 1)
+        m.add_params("model", np.arange(nfloats, dtype=np.float32))
+        sender.send_multicast(m, [1])
+
+    def wait(n, timeout=10.0):
+        deadline = time.monotonic() + timeout
+        while len(got) < n and time.monotonic() < deadline:
+            time.sleep(0.02)
+
+    def close():
+        sender.stop()
+        recv.stop()
+        hub.stop()
+
+    return send, wait, got, close
+
+
+def test_stripe_gap_kills_logical_frame_not_connection():
+    """A lost stripe must cost exactly its logical frame: the
+    reassembly aborts (counted), the connection survives, and the NEXT
+    frame arrives intact."""
+    from fedml_tpu.obs.telemetry import get_telemetry
+
+    state = {"n": 0}
+
+    def drop_second_stripe(mt, sid, idx, chunk):
+        if mt == "VICTIM" and idx == 1 and state["n"] == 0:
+            state["n"] += 1
+            return None  # swallowed: the reassembler sees a gap
+        return chunk
+
+    before = get_telemetry().snapshot()["counters"]
+    send, wait, got, close = _stripe_fault_rig(drop_second_stripe)
+    try:
+        send("VICTIM", 20_000)   # 80 KB -> 5 stripes, stripe 1 dropped
+        send("SURVIVOR", 20_000)
+        wait(1)
+        assert [m.type for m in got] == ["SURVIVOR"]
+        np.testing.assert_array_equal(
+            np.asarray(got[0].get("model")),
+            np.arange(20_000, dtype=np.float32))
+        after = get_telemetry().snapshot()["counters"]
+        key = "comm.stripe_aborts{msg_type=VICTIM,reason=gap}"
+        assert after.get(key, 0) - before.get(key, 0) == 1
+    finally:
+        close()
+
+
+def test_stripe_crc_catches_corruption():
+    """A corrupted stripe fails its crc32: the logical frame dies
+    (counted, reason=crc), nothing garbled is ever delivered, and the
+    stream keeps flowing."""
+    from fedml_tpu.obs.telemetry import get_telemetry
+
+    state = {"n": 0}
+
+    def corrupt_first(mt, sid, idx, chunk):
+        if mt == "VICTIM" and state["n"] == 0:
+            state["n"] += 1
+            bad = bytearray(chunk)
+            bad[0] ^= 0xFF
+            return bytes(bad)
+        return chunk
+
+    before = get_telemetry().snapshot()["counters"]
+    send, wait, got, close = _stripe_fault_rig(corrupt_first)
+    try:
+        send("VICTIM", 20_000)
+        send("SURVIVOR", 20_000)
+        wait(1)
+        assert [m.type for m in got] == ["SURVIVOR"]
+        after = get_telemetry().snapshot()["counters"]
+        key = "comm.stripe_aborts{msg_type=VICTIM,reason=crc}"
+        assert after.get(key, 0) - before.get(key, 0) == 1
+    finally:
+        close()
+
+
+def test_striped_traced_hop_chain_has_reasm_stamp():
+    """Tracing over the striped path: the hub restamps hub_out on the
+    per-receiver stripe-0 drain, and the receiver backdates a ``reasm``
+    hop to first-stripe arrival — the chain fed_timeline splits
+    bcast_deliver/stripe_reasm on."""
+    import time as _t
+
+    from fedml_tpu.comm.backend import NodeManager
+    from fedml_tpu.obs import trace_ctx
+
+    trace_ctx.set_enabled(True)
+    hub = TcpHub(stripe_bytes=16 << 10)
+    got = []
+
+    class Mgr(NodeManager):
+        def register_message_receive_handlers(self):
+            self.register_message_receive_handler(
+                "T", lambda m: got.append(m)
+            )
+
+    recv = TcpBackend(1, hub.host, hub.port)
+    Mgr(recv)
+    recv.run_in_thread()
+    sender = TcpBackend(2, hub.host, hub.port)
+    try:
+        sender.await_peers([1])
+        m = Message("T", 2, 1)
+        m.add_params("model", np.arange(20_000, dtype=np.float32))
+        m.add_params("round_idx", 7)
+        sender.send_multicast(m, [1])
+        deadline = _t.time() + 10
+        while not got and _t.time() < deadline:
+            _t.sleep(0.01)
+        _t.sleep(0.2)  # let the 'done' stamp land
+        assert got
+        ctx = got[0].params[trace_ctx.TRACE_KEY]
+        assert [h[1] for h in ctx["hops"]] \
+            == ["send", "hub_in", "hub_out", "reasm", "recv", "done"]
+        ts = [h[2] for h in ctx["hops"]]
+        assert ts == sorted(ts)  # reasm backdated, still monotone
+    finally:
+        sender.stop()
+        recv.stop()
+        hub.stop()
+        trace_ctx.set_enabled(None)
+
+
+def test_sender_pool_pacing_single_worker_interleaves_receivers():
+    """With ONE sender worker and pace=1 every receiver still streams:
+    the worker rotates a connection to the back of the ready queue
+    after each stripe instead of draining one receiver's whole
+    sequence first."""
+    import time
+
+    hub = TcpHub(senders=1, stripe_bytes=8 << 10, max_inflight_stripes=1)
+    got = {1: [], 2: [], 3: []}
+
+    class Obs:
+        def __init__(self, i):
+            self.i = i
+
+        def receive_message(self, t, m):
+            got[self.i].append(m)
+
+    receivers = []
+    for i in (1, 2, 3):
+        b = TcpBackend(i, hub.host, hub.port)
+        b.add_observer(Obs(i))
+        b.run_in_thread()
+        receivers.append(b)
+    sender = TcpBackend(9, hub.host, hub.port)
+    sender.await_peers([1, 2, 3])
+    payload = np.arange(50_000, dtype=np.float32)  # 200 KB -> 25 stripes
+    m = Message("PACE", 9, -1)
+    m.add_params("model", payload)
+    sender.send_multicast(m, [1, 2, 3])
+    deadline = time.monotonic() + 15
+    while any(not got[i] for i in (1, 2, 3)) and time.monotonic() < deadline:
+        time.sleep(0.02)
+    for i in (1, 2, 3):
+        assert got[i], f"node {i} starved under pace=1/senders=1"
+        np.testing.assert_array_equal(np.asarray(got[i][0].get("model")),
+                                      payload)
+    for b in receivers:
+        b.stop()
+    sender.stop()
+    hub.stop()
+
+
+@pytest.mark.parametrize("codec", ["none", "int8"])
+def test_striped_pipelined_federation_bit_identical(codec):
+    """THE determinism pin for ISSUE 8: striped fan-out + off-thread
+    decode/fold + double-buffered encode produce byte-identical final
+    models AND byte-identical client upload digests vs the whole-frame
+    serial baseline, fp32 and int8+EF (the fp64 num/den streaming fold
+    is exact at these magnitudes, so fold order cannot leak into the
+    bits)."""
+    base = _hub_federation(stripe_bytes=0, decode_workers=0, codec=codec)
+    fast = _hub_federation(stripe_bytes=256, decode_workers=2, codec=codec)
+    assert fast[2]["striped_mcasts"] >= 1
+    assert fast[2]["stripe_frames"] > 0
+    assert base[0] == fast[0], "final model bits differ striped vs whole"
+    assert base[1] == fast[1], "upload digests differ striped vs whole"
+
+
+def test_oversize_mcast_falls_back_to_whole_frame():
+    """A multicast frame larger than half the receiver reassembly
+    budget is NOT striped — striping it would overflow-abort on every
+    client and the cohort would never sync (round after round of
+    zero-participant closes).  The hub ships it whole instead:
+    functional beats fast."""
+    import time
+
+    from fedml_tpu.comm.tcp import _MAX_REASM_BYTES
+
+    hub = TcpHub(stripe_bytes=64 << 10, max_inflight_stripes=2)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+
+    rx = TcpBackend(1, hub.host, hub.port)
+    rx.add_observer(Obs())
+    rx.run_in_thread()
+    sender = TcpBackend(9, hub.host, hub.port)
+    sender.await_peers([1])
+    n = _MAX_REASM_BYTES // 2 // 4 + 1024  # just over the stripe cap
+    payload = np.arange(n, dtype=np.float32)
+    m = Message("MCAST_BIG", 9, -1)
+    m.add_params("model", payload)
+    sender.send_multicast(m, [1])
+    deadline = time.monotonic() + 30
+    while not got and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert got, "oversize multicast never delivered"
+    np.testing.assert_array_equal(np.asarray(got[0].get("model")), payload)
+    stats = hub.stats()
+    assert stats["striped_mcasts"] == 0 and stats["stripe_frames"] == 0
+    rx.stop()
+    sender.stop()
+    hub.stop()
+
+
+def test_stale_partial_stream_evicted_on_byte_pressure():
+    """A partial stripe stream whose final never arrives (hub reconnect
+    killed its tail mid-broadcast) must not hold the reassembly byte
+    budget forever: when a LIVE stream needs the bytes, the stale one
+    is evicted (counted reason=stale) and the live broadcast still
+    assembles — one outage costs one frame, never all future ones."""
+    import zlib as _zlib
+
+    from fedml_tpu.obs.telemetry import get_telemetry
+
+    hub = TcpHub()
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+
+    b = TcpBackend(1, hub.host, hub.port)
+    b.add_observer(Obs())
+    b.run_in_thread()
+    try:
+        budget = b._MAX_REASM_BYTES
+
+        def stripe(sid, i, n, chunk):
+            return ({"sid": sid, "i": i, "n": n, "msg_type": "BIG",
+                     "crc": _zlib.crc32(chunk)}, chunk)
+
+        # stale stream: one stripe of budget-32 bytes, final never comes
+        big = b"\x00" * (budget - 32)
+        f, c = stripe(101, 0, 2, big)
+        b._on_stripe(f, c, nbytes=len(c))
+        assert b._reasm_bytes == len(big)
+        # live stream: a small real frame that does NOT fit the residue
+        m = Message("BIG", 9, -1)
+        m.add_params("w", np.arange(64, dtype=np.float32))
+        frame = m.to_frame()
+        half = len(frame) // 2
+        before = get_telemetry().snapshot()["counters"]
+        for i, chunk in enumerate((frame[:half], frame[half:])):
+            f, c = stripe(202, i, 2, chunk)
+            b._on_stripe(f, c, nbytes=len(c))
+        after = get_telemetry().snapshot()["counters"]
+        assert got and np.asarray(got[0].get("w")).shape == (64,)
+        key = "comm.stripe_aborts{msg_type=BIG,reason=stale}"
+        assert after.get(key, 0) - before.get(key, 0) == 1
+        assert 101 not in b._reasm and b._reasm_bytes == 0
+    finally:
+        b.stop()
+        hub.stop()

@@ -5,6 +5,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+from fedml_tpu.parallel.compat import shard_map
 from fedml_tpu.algorithms.decentralized import (
     DecentralizedSimulation,
     dense_mix,
@@ -103,7 +104,7 @@ def test_gossip_spmd_ring_matches_dense_ring():
 
     mesh = Mesh(np.array(jax.devices()[:n]), ("clients",))
     ring_fn = jax.jit(
-        jax.shard_map(
+        shard_map(
             make_gossip_round_fn(lu, None, axis_name="clients", ring=True),
             mesh=mesh,
             in_specs=(P("clients"), P("clients"), P("clients"), P("clients"), P(), P("clients")),

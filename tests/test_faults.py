@@ -777,3 +777,131 @@ def test_chaos_tcp_duplicate_payload_intact_and_memo_unmutated():
         from fedml_tpu.obs.telemetry import get_telemetry
 
         get_telemetry().drain_events()
+
+
+# ---------------------------------------------------------------------------
+# Stripe-level faults (ISSUE 8): a dropped/corrupted stripe kills the
+# whole logical frame — never a wedged reassembly
+# ---------------------------------------------------------------------------
+
+def test_stripe_rule_validation():
+    """Stripe rules are drop|corrupt only (a stripe is a wire fragment,
+    not a message) and cannot filter by round (the round index lives
+    inside the not-yet-reassembled inner frame)."""
+    FaultRule(action="drop", direction="stripe")  # valid
+    FaultRule(action="corrupt", direction="stripe")  # valid
+    with pytest.raises(ValueError, match="drop|corrupt"):
+        FaultRule(action="delay", direction="stripe")
+    with pytest.raises(ValueError, match="round"):
+        FaultRule(action="drop", direction="stripe", round=1)
+
+
+def test_stripe_rule_json_roundtrip():
+    plan = FaultPlan(
+        seed=3,
+        rules=[FaultRule(action="drop", direction="stripe",
+                         msg_type="S2C_SYNC_MODEL", node=2)],
+    )
+    back = FaultPlan.from_json(plan.to_json())
+    assert back.rules == plan.rules
+
+
+def test_chaos_stripe_faults_kill_frame_deterministically():
+    """ChaosBackend installs its stripe hook on the wrapped TcpBackend:
+    a stripe drop rule starves the reassembler (gap abort upstream or
+    missing final), a corrupt rule trips the crc — either way the
+    logical frame dies, unfaulted types flow, and injected counters +
+    the pinned trace record every stripe decision."""
+    from fedml_tpu.comm.tcp import TcpBackend, TcpHub
+
+    tel = get_telemetry()
+    before = tel.snapshot()["counters"]
+    hub = TcpHub(stripe_bytes=8 << 10, max_inflight_stripes=2)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+
+    plan = FaultPlan(
+        seed=0,
+        rules=[FaultRule(action="drop", direction="stripe",
+                         msg_type="VICTIM")],
+        msg_types=("VICTIM",),
+    )
+    inner = TcpBackend(1, hub.host, hub.port)
+    chaos = ChaosBackend(inner, plan)
+    chaos.add_observer(Obs())
+    chaos.run_in_thread()
+    sender = TcpBackend(2, hub.host, hub.port)
+    try:
+        sender.await_peers([1])
+        for tag in ("VICTIM", "SURVIVOR"):
+            m = Message(tag, 2, 1)
+            m.add_params("model", np.arange(10_000, dtype=np.float32))
+            sender.send_multicast(m, [1])
+        deadline = time.monotonic() + 10
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.3)  # the VICTIM must NOT trickle in late
+        assert [m.type for m in got] == ["SURVIVOR"]
+        after = tel.snapshot()["counters"]
+        key = "faults.injected{action=drop_stripe,msg_type=VICTIM}"
+        n_stripes = -(-10_000 * 4 // (8 << 10))
+        assert after.get(key, 0) - before.get(key, 0) == n_stripes
+        # every stripe decision rides the pinned chaos trace
+        stripe_trace = [t for t in chaos.trace if t[0] == "stripe"]
+        assert len(stripe_trace) == n_stripes
+        assert all(t[1] == "VICTIM" and t[3] == ("drop",)
+                   for t in stripe_trace)
+    finally:
+        sender.stop()
+        chaos.stop()
+        hub.stop()
+
+
+def test_chaos_stripe_corrupt_trips_crc():
+    from fedml_tpu.comm.tcp import TcpBackend, TcpHub
+
+    tel = get_telemetry()
+    before = tel.snapshot()["counters"]
+    hub = TcpHub(stripe_bytes=8 << 10, max_inflight_stripes=2)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+
+    plan = FaultPlan(
+        seed=0,
+        rules=[FaultRule(action="corrupt", direction="stripe",
+                         msg_type="VICTIM")],
+        msg_types=("VICTIM",),
+    )
+    inner = TcpBackend(1, hub.host, hub.port)
+    chaos = ChaosBackend(inner, plan)
+    chaos.add_observer(Obs())
+    chaos.run_in_thread()
+    sender = TcpBackend(2, hub.host, hub.port)
+    try:
+        sender.await_peers([1])
+        for tag in ("VICTIM", "SURVIVOR"):
+            m = Message(tag, 2, 1)
+            m.add_params("model", np.arange(10_000, dtype=np.float32))
+            sender.send_multicast(m, [1])
+        deadline = time.monotonic() + 10
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+        time.sleep(0.3)
+        assert [m.type for m in got] == ["SURVIVOR"]
+        after = tel.snapshot()["counters"]
+        # the FIRST corrupted stripe aborts the stream (crc); later
+        # stripes of the dead sid are ignored before the hook runs
+        key = "comm.stripe_aborts{msg_type=VICTIM,reason=crc}"
+        assert after.get(key, 0) - before.get(key, 0) == 1
+        injected = "faults.injected{action=corrupt_stripe,msg_type=VICTIM}"
+        assert after.get(injected, 0) - before.get(injected, 0) >= 1
+    finally:
+        sender.stop()
+        chaos.stop()
+        hub.stop()

@@ -400,3 +400,60 @@ def test_trace_disabled_attaches_nothing():
         assert trace_ctx.fork_copy(m) is m
     finally:
         trace_ctx.set_enabled(None)
+
+
+def test_fed_timeline_stripe_and_pipeline_phases(tmp_path):
+    """tools/fed_timeline on synthetic per-process records: the striped
+    fan-out's reasm hop splits bcast_deliver/stripe_reasm, the
+    round_close pipeline fields surface as decode_wait (subtracted from
+    decode_fold) + encode_overlap, and the cohort delivery skew is one
+    number."""
+    import json as _json
+    import sys as _sys
+
+    _sys.path.insert(0, "tools")
+    import fed_timeline
+
+    def w(name, recs):
+        with open(tmp_path / name, "w") as fh:
+            for r in recs:
+                fh.write(_json.dumps(r) + "\n")
+
+    # hub clock == node clocks (offset 0) for arithmetic transparency
+    sync_hops = lambda node, recv_t: {
+        "kind": "trace_hop", "rid": "r", "seq": node, "copy": 0, "org": 0,
+        "round": 0, "msg_type": "S2C_SYNC_MODEL", "node": node, "t0": 0.0,
+        "hops": [[0, "send", 0.010], ["hub", "hub_in", 0.020],
+                 ["hub", "hub_out", 0.030], [node, "reasm", 0.040],
+                 [node, "recv", 0.060 + 0.010 * node],
+                 [node, "done", 0.200]],
+    }
+    upload = {
+        "kind": "trace_hop", "rid": "r", "seq": 9, "copy": 0, "org": 1,
+        "round": 0, "msg_type": "C2S_SEND_MODEL", "node": 0, "t0": 0.200,
+        "hops": [[1, "send", 0.210], ["hub", "hub_in", 0.220],
+                 ["hub", "hub_out", 0.230], [0, "recv", 0.240],
+                 [0, "done", 0.260]],
+    }
+    close = {"kind": "round_close", "round": 0, "participants": 2,
+             "time_agg": 0.001, "t_open_m": 0.0, "t_close_m": 0.252,
+             "decode_wait_s": 0.004, "decode_s": 0.005,
+             "encode_overlap_s": 0.015}
+    w("metrics-node0.jsonl", [sync_hops(1, 0), sync_hops(2, 0), upload,
+                              close])
+    bundle = fed_timeline.load_run(str(tmp_path))
+    rows = fed_timeline.build_rounds(bundle)
+    assert len(rows) == 1
+    r = rows[0]
+    assert abs(r["bcast_deliver"] - 0.010) < 1e-9   # hub_out -> reasm
+    assert abs(r["stripe_reasm"] - 0.030) < 1e-9    # reasm -> recv (node 1)
+    assert abs(r["decode_wait"] - 0.004) < 1e-9
+    # decode_fold = recv->close - normalize - decode_wait
+    assert abs(r["decode_fold"] - (0.252 - 0.240 - 0.001 - 0.004)) < 1e-9
+    assert abs(r["encode_overlap"] - 0.015) < 1e-9
+    # skew across the two receivers' recv stamps: 0.080 - 0.070
+    assert abs(r["bcast_skew"] - 0.010) < 1e-9
+    summary = fed_timeline.summarize(rows)
+    assert summary["p50_extra_s"]["bcast_skew"] is not None
+    # critical-path phases never double-count: accounted <= wall
+    assert r["accounted_s"] <= r["wall_s"] + 1e-9

@@ -8,7 +8,7 @@ import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
 
-shard_map = jax.shard_map
+from fedml_tpu.parallel.compat import shard_map
 
 from fedml_tpu.parallel.ring_attention import (blockwise_attention,
                                                dense_attention,

@@ -20,6 +20,11 @@ round with a finite global model.  Default matrix:
     corrupt_payload      one client's uploads are NaN-corrupted every
                          round; the server must reject them pre-
                          aggregation
+    stripe_faults        striped broadcast, 1 KiB stripes: one node
+                         loses a stripe (gap), another gets a corrupted
+                         one (crc) — each must cost exactly one node's
+                         sync (deadline straggler), never a wedged
+                         reassembly
 
 Per scenario the output records: survived, rounds completed, rounds
 aggregated empty (``zero_participant_rounds``), degraded rounds,
@@ -71,6 +76,23 @@ def _scenarios(round_timeout: float):
                          msg_type="C2S_SEND_MODEL", direction="send")],
         roles=("client",),
     ).to_json()
+    # stripe-level faults on the striped broadcast path, harshest
+    # sustained form: node 2 loses EVERY sync stripe (never assembles a
+    # sync — a full broadcast blackout) and node 3 gets every stripe
+    # corrupted (crc mismatch aborts each round's frame).  Both nodes
+    # must degrade to deadline stragglers round after round without
+    # wedging reassembly or the federation.  The surgical single-stripe
+    # cases (one dropped stripe -> gap abort, one corrupted -> crc
+    # abort, logical frame dies, connection survives) are pinned at
+    # unit level in tests/test_comm.py.
+    stripe_plan = FaultPlan(
+        seed=0,
+        rules=[FaultRule(action="drop", node=2,
+                         msg_type="S2C_SYNC_MODEL", direction="stripe"),
+               FaultRule(action="corrupt", node=3,
+                         msg_type="S2C_SYNC_MODEL", direction="stripe")],
+        roles=("client",),
+    ).to_json()
     return {
         "fault_free": {},
         "client_crash": {
@@ -93,6 +115,12 @@ def _scenarios(round_timeout: float):
         "corrupt_payload": {
             "chaos_plan": corrupt_plan,
             "round_timeout": round_timeout,
+        },
+        "stripe_faults": {
+            "chaos_plan": stripe_plan,
+            "round_timeout": round_timeout,
+            # small stripes so even the tiny test model stripes
+            "stripe_kib": 1,
         },
     }
 

@@ -49,12 +49,21 @@ HUB = "hub"
 SYNC_TYPES = ("S2C_INIT_CONFIG", "S2C_SYNC_MODEL")
 UPLOAD_TYPE = "C2S_SEND_MODEL"
 
-# breakdown phases in critical-path order (the report's row order)
+# breakdown phases in critical-path order (the report's row order).
+# stripe_reasm (striped fan-out: first-stripe arrival -> delivery) and
+# decode_wait (pipelined server: reader submit -> decode-pool pickup)
+# are zero/absent on the whole-frame / serial paths.
 PHASES = [
-    "serialize", "bcast_queue", "bcast_deliver", "client_train",
-    "upload_serialize", "upload_wire", "upload_queue", "upload_deliver",
-    "decode_fold", "close",
+    "serialize", "bcast_queue", "bcast_deliver", "stripe_reasm",
+    "client_train", "upload_serialize", "upload_wire", "upload_queue",
+    "upload_deliver", "decode_wait", "decode_fold", "close",
 ]
+
+# informational rows reported alongside but NOT summed into the
+# critical path: encode_overlap is the next broadcast's off-thread
+# encode+send (it overlaps other phases by design), bcast_skew is the
+# cohort's max-min sync delivery spread (stripe fairness in one number)
+EXTRA_ROWS = ["encode_overlap", "bcast_skew"]
 
 
 def _read_jsonl(path: str) -> List[dict]:
@@ -183,7 +192,14 @@ def build_rounds(bundle: dict) -> List[dict]:
             row["critical_client"] = crit_org
             row["serialize"] = _span(sy_t0, sy.get("send"))
             row["bcast_queue"] = _span(sy.get("hub_in"), sy.get("hub_out"))
-            row["bcast_deliver"] = _span(sy.get("hub_out"), sy.get("recv"))
+            # striped fan-out: hub_out -> reasm (first stripe landed) is
+            # the fan-out leg proper; reasm -> recv is the streaming/
+            # reassembly wait.  Whole frames have no reasm hop and the
+            # old single-span semantics are preserved.
+            sy_arrive = sy.get("reasm", sy.get("recv"))
+            row["bcast_deliver"] = _span(sy.get("hub_out"), sy_arrive)
+            row["stripe_reasm"] = (_span(sy.get("reasm"), sy.get("recv"))
+                                   if "reasm" in sy else None)
             # train = sync arrival -> upload-send entry on the client
             # (the upload ctx's t0 is stamped at send ENTRY, after the
             # local update ran inside the sync handler)
@@ -199,10 +215,25 @@ def build_rounds(bundle: dict) -> List[dict]:
             t_close = (_hub_t(offsets, 0, rc["t_close_m"])
                        if rc.get("t_close_m") is not None else None)
             fold_close = _span(up.get("recv"), t_close)
+            # pipelined decode: the closing upload's pool queue wait is
+            # its own phase (carried on the round_close record), and
+            # decode_fold is the remainder so the chain never double-
+            # counts it
+            row["decode_wait"] = rc.get("decode_wait_s")
             row["decode_fold"] = (
                 fold_close - (rc.get("time_agg") or 0.0)
+                - (rc.get("decode_wait_s") or 0.0)
                 if fold_close is not None else
                 _span(up.get("recv"), up.get("done")))
+            row["encode_overlap"] = rc.get("encode_overlap_s")
+            # stripe-fairness number: cohort-wide sync delivery skew
+            # (max - min recv across receivers) — striping's whole job
+            # is to shrink this
+            recvs = [h.get("recv")
+                     for h in (_hop_map(r, offsets) for r in sys_.values())]
+            recvs = [t for t in recvs if t is not None]
+            row["bcast_skew"] = (max(recvs) - min(recvs)
+                                 if len(recvs) > 1 else None)
             # cohort-wide spread (evidence for contention vs queue wait)
             queues = [_span(h.get("hub_in"), h.get("hub_out"))
                       for h in (_hop_map(r, offsets) for r in ups.values())]
@@ -253,8 +284,11 @@ def summarize(rows: List[dict]) -> dict:
         for k, v in p50.items():
             if v is not None:
                 shares[k] = round(v / wall, 4)
+    extras = {p: percentile([r.get(p) for r in rows], 0.5)
+              for p in EXTRA_ROWS}
     return {"p50_round_wall_s": wall, "p50_phase_s": p50,
             "phase_share_of_wall": shares,
+            "p50_extra_s": extras,
             "rounds": len(rows)}
 
 
@@ -320,6 +354,8 @@ def to_perfetto(bundle: dict, rows: List[dict]) -> dict:
                    _hub_t(offsets, org, float(t0)), h["send"], to=node)
         slice_(0, f"hub queue {tag} -> {node}",
                h.get("hub_in"), h.get("hub_out"), receiver=node)
+        slice_(_pid(node), f"reassemble {tag}", h.get("reasm"),
+               h.get("recv"), sender=org)
         slice_(_pid(node), f"handle {tag}", h.get("recv"), h.get("done"),
                sender=org)
     for rc in bundle["rounds"]:
@@ -352,13 +388,14 @@ def _fmt_ms(v) -> str:
 
 def render(rows: List[dict], summary: dict, copies: List[dict]) -> str:
     lines = ["== per-round critical path (ms, hub clock) =="]
-    hdr = ["round", "wall"] + PHASES + ["other", "crit_client"]
+    hdr = ["round", "wall"] + PHASES + ["other", "crit_client"] + EXTRA_ROWS
     lines.append(" ".join(f"{h:>12}" for h in hdr))
     for r in rows:
         vals = [f"{r['round']:>12}", _fmt_ms(r.get("wall_s")).rjust(12)]
         vals += [_fmt_ms(r.get(p)).rjust(12) for p in PHASES]
         vals += [_fmt_ms(r.get("other_s")).rjust(12),
                  str(r.get("critical_client", "-")).rjust(12)]
+        vals += [_fmt_ms(r.get(p)).rjust(12) for p in EXTRA_ROWS]
         lines.append(" ".join(vals))
     lines.append("")
     lines.append("== aggregate (p50 across rounds) ==")
@@ -369,6 +406,10 @@ def render(rows: List[dict], summary: dict, copies: List[dict]) -> str:
         share = summary["phase_share_of_wall"].get(p)
         pct = f"{share * 100:5.1f}%" if share is not None else "     -"
         lines.append(f"  {p:>16}: {_fmt_ms(v).strip():>9} ms  {pct}")
+    for p in EXTRA_ROWS:
+        v = summary.get("p50_extra_s", {}).get(p)
+        lines.append(f"  {p:>16}: {_fmt_ms(v).strip():>9} ms  "
+                     "(informational, not on the critical path)")
     if copies:
         lines.append("")
         lines.append(f"== chaos duplicate copies: {len(copies)} "

@@ -56,6 +56,71 @@ from fedml_tpu.obs.telemetry import get_telemetry
 SERVER = 0
 
 
+def encode_client_upload(codec_name: str, new_vars, synced_vars, template,
+                         *, seed: int, round_idx: int, slot: int, ef=None):
+    """Build one client's upload wiretree — THE shared encode for the
+    single-process client manager and the muxer's vmapped cohort engine
+    (``algorithms/fedavg_mux``), so the two paths cannot drift and
+    muxed-vs-per-process uploads stay byte-identical by construction.
+
+    No codec: the full-precision v2 wiretree.  With a codec: the
+    codec-encoded DELTA (trained - synced), the error-feedback residual
+    folded in and the new quantization error absorbed back into ``ef``.
+    The encode key is the engine's exact compression stream —
+    ``fold_in(fold_in(fold_in(seed_key, round), COMPRESS_STREAM),
+    slot)`` — so encoded bytes are a pure function of
+    (seed, round, slot): bit-identical across processes, muxers, and
+    re-runs.
+
+    Returns ``(wire, raw_nbytes, encoded_nbytes)`` — the byte pair is
+    ``(None, None)`` on the uncompressed path (nothing was encoded).
+    """
+    from fedml_tpu.compress import COMPRESS_STREAM, get_codec
+
+    codec = get_codec(codec_name)
+    if codec is None:
+        return tree_to_wire(new_vars), None, None
+    delta = jax.tree_util.tree_map(
+        lambda n, s: np.asarray(n, np.float32) - np.asarray(s, np.float32),
+        new_vars, synced_vars,
+    )
+    if ef is not None:
+        delta = ef.fold_in(delta)
+    k_round = jax.random.fold_in(jax.random.PRNGKey(seed), round_idx)
+    key = jax.random.fold_in(
+        jax.random.fold_in(k_round, COMPRESS_STREAM), slot
+    )
+    wire = tree_to_wire(delta, codec=codec, key=key, delta=True)
+    if ef is not None:
+        ef.absorb(delta, tree_from_wire(wire, template))
+    raw = sum(
+        int(np.asarray(l).size) * 4
+        for l in jax.tree_util.tree_leaves(delta)
+    )
+    comp = sum(
+        int(np.asarray(v).nbytes)
+        for leaf in wire["leaves"] for v in leaf["enc"].values()
+    )
+    return wire, raw, comp
+
+
+def ef_for(store: dict, key, codec_name: str, enabled: bool):
+    """One error-feedback residual per uplink stream (client / virtual
+    client), created lazily and ONLY when a lossy codec is negotiated —
+    the single gating rule both the per-process manager and the muxer
+    use.  Gating drift between the two call sites would silently break
+    muxed-vs-per-process byte-identity, so it lives here, next to the
+    shared encode."""
+    from fedml_tpu.compress import ErrorFeedback, get_codec
+
+    if not enabled or get_codec(codec_name) is None:
+        return None
+    ef = store.get(key)
+    if ef is None:
+        ef = store[key] = ErrorFeedback()
+    return ef
+
+
 class FedAvgServerManager(NodeManager):
     """Rank-0 coordinator: sample → broadcast → collect → aggregate.
 
@@ -740,7 +805,7 @@ class FedAvgClientManager(NodeManager):
         # key): EF keeps the per-round quantization error and folds it
         # into the next update — on by default for lossy codecs
         self.error_feedback = error_feedback
-        self._ef = None
+        self._ef = {}  # ef_for store; one entry (this client's stream)
         # sha256 over every encoded upload's payload buffers, in send
         # order — the reproducibility probe a federation re-run compares
         # (same seed => identical digest)
@@ -820,53 +885,23 @@ class FedAvgClientManager(NodeManager):
 
     def _encode_upload(self, codec_name: str, new_vars, synced_vars,
                        round_idx: int, slot: int):
-        """Build the upload wiretree: full-precision v2 when the server
-        negotiated no codec; otherwise the codec-encoded DELTA
-        (trained - synced), with the EF residual folded in and the new
-        quantization error kept for the next round.  The encode key is
-        the engine's exact compression stream —
-        ``fold_in(fold_in(fold_in(seed_key, round), COMPRESS_STREAM),
-        slot)`` — so encoded bytes are a pure function of
-        (seed, round, slot): bit-identical across processes and re-runs.
-        """
-        from fedml_tpu.compress import (
-            COMPRESS_STREAM,
-            ErrorFeedback,
-            get_codec,
-            wire_tree_digest,
-        )
+        """Encode this client's upload via the SHARED
+        ``encode_client_upload`` (the muxer's cohort engine calls the
+        same function — byte-identity by construction) and fold it into
+        the reproducibility digest.  Since the muxer landed, the digest
+        covers EVERY upload — full-precision wiretrees included — so a
+        same-seed muxed-vs-per-process comparison pins the fp32 path
+        too, not just the codec one."""
+        from fedml_tpu.compress import wire_tree_digest
         from fedml_tpu.obs import comm_obs
 
-        codec = get_codec(codec_name)
-        if codec is None:
-            return tree_to_wire(new_vars)
-        delta = jax.tree_util.tree_map(
-            lambda n, s: np.asarray(n, np.float32)
-            - np.asarray(s, np.float32),
-            new_vars, synced_vars,
+        wire, raw, comp = encode_client_upload(
+            codec_name, new_vars, synced_vars, self.template,
+            seed=self.seed, round_idx=round_idx, slot=slot,
+            ef=ef_for(self._ef, 0, codec_name, self.error_feedback),
         )
-        if self.error_feedback:
-            if self._ef is None:
-                self._ef = ErrorFeedback()
-            delta = self._ef.fold_in(delta)
-        k_round = jax.random.fold_in(
-            jax.random.PRNGKey(self.seed), round_idx
-        )
-        key = jax.random.fold_in(
-            jax.random.fold_in(k_round, COMPRESS_STREAM), slot
-        )
-        wire = tree_to_wire(delta, codec=codec, key=key, delta=True)
-        if self.error_feedback:
-            self._ef.absorb(delta, tree_from_wire(wire, self.template))
-        raw = sum(
-            int(np.asarray(l).size) * 4
-            for l in jax.tree_util.tree_leaves(delta)
-        )
-        comp = sum(
-            int(np.asarray(v).nbytes)
-            for leaf in wire["leaves"] for v in leaf["enc"].values()
-        )
-        comm_obs.record_compression(MSG_TYPE_C2S_SEND_MODEL, raw, comp)
+        if raw is not None:
+            comm_obs.record_compression(MSG_TYPE_C2S_SEND_MODEL, raw, comp)
         self._upload_hash.update(wire_tree_digest(wire).encode())
         return wire
 

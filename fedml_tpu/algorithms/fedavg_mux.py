@@ -1,0 +1,385 @@
+"""Muxer-side FedAvg: N virtual clients, ONE process, ONE jit step.
+
+The wire half of virtual-client multiplexing lives in ``comm/mux.py``
+(hello v2, per-connection broadcast dedup, local demux).  This module
+is the compute half: a cohort manager that turns the co-located sync
+deliveries of one broadcast into ONE vmapped local update —
+``jax.vmap`` over the SAME explicitly-vmappable ``make_local_update``
+scan the simulation engine jits (``algorithms/fedavg.py``) — and then
+uploads K codec-encoded deltas whose bytes are bit-identical to the
+one-process-per-client path:
+
+- each virtual client's ``client_idx``/``slot``/rng stream is derived
+  exactly as ``FedAvgClientManager._on_sync`` derives them (client =
+  node - 1, slot defaults to client_idx, rng =
+  ``fold_in(fold_in(fold_in(seed_key, round), 0), slot)``);
+- per-client packs are id-keyed (``pack_clients``), so a cohort pack's
+  row k IS the single-client pack for that id;
+- uploads go through the SHARED ``encode_client_upload``
+  (``fedavg_cross_device``) with a per-virtual-client error-feedback
+  store — encoded bytes are a pure function of (seed, round, slot).
+
+Chaos/trace/obs parity: every virtual client sits behind its own
+``VirtualNodeBackend`` (optionally chaos-wrapped per node), so
+FaultRule decisions, trace hop chains, and telemetry identities match
+the per-process topology — a drop rule for virtual node 3 drops only
+node 3's sync copy, and node 3 simply isn't in that round's vmapped
+cohort.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fedml_tpu.comm.backend import CommBackend, NodeManager
+from fedml_tpu.comm.message import (
+    MSG_ARG_KEY_CLIENT_INDEX,
+    MSG_ARG_KEY_LOCAL_METRICS,
+    MSG_ARG_KEY_MODEL_PARAMS,
+    MSG_ARG_KEY_NUM_SAMPLES,
+    MSG_ARG_KEY_ROUND_INDEX,
+    MSG_TYPE_C2S_SEND_MODEL,
+    MSG_TYPE_S2C_FINISH,
+    MSG_TYPE_S2C_INIT_CONFIG,
+    MSG_TYPE_S2C_SYNC_MODEL,
+    Message,
+    tree_from_wire,
+)
+from fedml_tpu.algorithms.fedavg_cross_device import (
+    MSG_ARG_KEY_CODEC,
+    SERVER,
+    encode_client_upload,
+    ef_for,
+)
+from fedml_tpu.comm.mux import TcpMuxBackend
+from fedml_tpu.core.client import LocalUpdateFn
+from fedml_tpu.core.types import FedDataset, pack_clients
+
+
+class _VirtualEndpoint(NodeManager):
+    """One virtual client's protocol endpoint: registers the standard
+    client-side handlers on its (possibly chaos-wrapped) virtual
+    backend and forwards into the shared cohort collector.  Keeping a
+    real ``NodeManager`` per virtual node preserves the per-node
+    handler-latency telemetry and the trace 'done' stamps the
+    per-process topology emits."""
+
+    def __init__(self, backend: CommBackend,
+                 cohort: "FedAvgMuxClientManager"):
+        self.cohort = cohort
+        super().__init__(backend)
+
+    def register_message_receive_handlers(self) -> None:
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_INIT_CONFIG, self._on_sync)
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_SYNC_MODEL, self._on_sync)
+        self.register_message_receive_handler(
+            MSG_TYPE_S2C_FINISH, self._on_finish)
+
+    def _on_sync(self, msg: Message) -> None:
+        self.cohort._enqueue_sync(self.backend.node_id, msg)
+
+    def _on_finish(self, msg: Message) -> None:
+        self.cohort._on_finish(self.backend.node_id, msg)
+
+
+class FedAvgMuxClientManager:
+    """Drives every virtual client of one muxed connection.
+
+    Collection contract: sync handlers only ENQUEUE (cheap — the trace
+    'done' stamp marks delivery, not training); the mux backend's
+    post-dispatch flush hook then trains everyone the physical frame
+    reached in one vmapped step.  A sync arriving OUTSIDE a dispatch
+    (a chaos-delayed copy re-injected from a timer thread) trains
+    immediately as its own — possibly singleton — cohort: late copies
+    behave like the late stragglers they are.
+
+    Syncs carrying DIFFERENT payloads (a chaos-corrupted copy, mixed
+    rounds after a delay) group by payload identity and train as
+    separate cohorts: a NaN-corrupted sync NaN-poisons exactly its own
+    virtual client's upload, which the server's corrupt-upload firewall
+    then rejects — the same blast radius as the per-process path.
+    """
+
+    # reader-thread dispatch flushes vs chaos-timer-thread flushes:
+    # the enqueue list rides its own lock; everything a flush TOUCHES
+    # (pack cache, EF stores, digests) is serialized by _train_lock
+    _GUARDED_BY = {
+        "_pending": "_plock",
+        "_pack_key": "_train_lock",
+        "_pack_ids": "_train_lock",
+        "_pack_index": "_train_lock",
+        "_pack_host": "_train_lock",
+        "_pack_dev": "_train_lock",
+    }
+
+    def __init__(
+        self,
+        mux: TcpMuxBackend,
+        local_update: LocalUpdateFn,
+        dataset: FedDataset,
+        *,
+        batch_size: int,
+        template_variables,
+        seed: int = 0,
+        error_feedback: bool = True,
+        train_delay: float = 0.0,
+        crash_at_round: Optional[int] = None,
+        wrap_backend: Optional[Callable[[CommBackend], CommBackend]] = None,
+    ):
+        self.mux = mux
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.template = template_variables
+        self.seed = seed
+        self.error_feedback = error_feedback
+        self.train_delay = train_delay
+        self.crash_at_round = crash_at_round
+        # ONE jit step for the whole cohort: vmap over the packed
+        # client axis of the same local-update operator the sim engine
+        # jits; the synced variables broadcast (in_axes=None), exactly
+        # the sim's run_one closure.  jit re-specializes per cohort
+        # size — constant in the steady state (every round's broadcast
+        # reaches the same co-located subset), and chaos-induced
+        # stragglers just compile their own (smaller) shape once.
+        self._cohort_update = jax.jit(
+            jax.vmap(local_update.fn, in_axes=(None, 0, 0, 0, 0))
+        )
+        from fedml_tpu.analysis.locks import make_lock
+
+        self._pending: List[tuple] = []
+        self._plock = make_lock("FedAvgMuxClientManager._plock")
+        # serializes whole flushes: the reader thread's dispatch flush
+        # and a chaos-delayed copy's timer-thread flush share the pack
+        # cache, the per-node EF stores, and the digest hashes — an
+        # interleaved train would swap the pack out from under the
+        # bigger cohort (or tear an EF residual) mid-step
+        self._train_lock = make_lock("FedAvgMuxClientManager._train_lock")
+        self._finished = threading.Event()
+        # cohort pack cache: packs are round-independent (the local
+        # update re-permutes per epoch from the (seed, round, slot)
+        # stream) and per-client id-keyed, so row k of any cohort pack
+        # is bit-identical to client k's single-client pack.  At 10k
+        # virtual clients a per-round repack (10k seeded permutations
+        # + a multi-MB gather) would dominate the round — so the cache
+        # holds ONE pack covering the superset of ids seen (seeded
+        # with this muxer's default client range) and every cohort —
+        # including a per-round SAMPLED subset — row-slices it.  The
+        # full-cohort steady state reuses the cached device arrays
+        # with no per-round copy at all.
+        self._pack_key = None        # (steps_per_epoch, batch_size)
+        self._pack_ids = None        # ids the cached pack covers, in order
+        self._pack_index = None     # client id -> row
+        self._pack_host = None      # (x, y, mask, num_samples) numpy
+        self._pack_dev = None       # full-cohort jnp arrays (fast path)
+        self._ef: Dict[int, object] = {}
+        self._hash = {n: hashlib.sha256() for n in mux.node_ids}
+        self.rounds_trained = {n: 0 for n in mux.node_ids}
+        self._endpoints: Dict[int, _VirtualEndpoint] = {}
+        for n in mux.node_ids:
+            vb = mux.virtual(n)
+            backend = wrap_backend(vb) if wrap_backend is not None else vb
+            self._endpoints[n] = _VirtualEndpoint(backend, self)
+        mux.add_flush_hook(self._flush)
+
+    # -- collection ---------------------------------------------------------
+    def _enqueue_sync(self, node: int, msg: Message) -> None:
+        with self._plock:
+            self._pending.append((node, msg))
+        if not self.mux.in_dispatch():
+            # delayed/re-injected copy on a timer thread: no dispatch
+            # flush is coming — train it now as its own cohort
+            self._flush()
+
+    def _on_finish(self, node: int, msg: Message) -> None:
+        if not self._finished.is_set():
+            self._finished.set()
+            self.mux.stop()
+
+    # -- cohort training ----------------------------------------------------
+    def _flush(self) -> None:
+        with self._train_lock:
+            self._flush_locked()
+
+    def _flush_locked(self) -> None:  # fedlint: holds=_train_lock
+        with self._plock:
+            pending, self._pending = self._pending, []
+        if not pending:
+            return
+        if self.crash_at_round is not None and any(
+            m.get(MSG_ARG_KEY_ROUND_INDEX) == self.crash_at_round
+            for _, m in pending
+        ):
+            import os
+
+            # the muxer-process twin of the client --crash-at-round
+            # knob: os._exit skips cleanup, so HUNDREDS of virtual
+            # clients vanish mid-protocol at once — the blast radius
+            # tools/chaos_run.py's muxer_crash scenario exercises
+            os._exit(137)
+        if self.train_delay:
+            time.sleep(self.train_delay)
+        # group by payload identity: clones of one broadcast share the
+        # wiretree OBJECT (decode once per group); a chaos-corrupted
+        # copy is a fresh object and trains — and fails — alone
+        groups: Dict[int, tuple] = {}
+        order: List[int] = []
+        for node, msg in pending:
+            key = id(msg.get(MSG_ARG_KEY_MODEL_PARAMS))
+            if key not in groups:
+                groups[key] = (msg, [])
+                order.append(key)
+            groups[key][1].append((node, msg))
+        for key in order:
+            ref_msg, entries = groups[key]
+            try:
+                self._train_cohort(ref_msg, entries)
+            except Exception:
+                # one cohort's failure (undecodable sync, engine bug)
+                # must not take down the other groups or the reader
+                # thread: those virtual clients become stragglers this
+                # round, the deadline covers them
+                logging.exception(
+                    "muxer %d: cohort train failed for nodes %s",
+                    self.mux.node_id, [n for n, _ in entries],
+                )
+
+    def _train_cohort(self, ref_msg: Message, entries: List[tuple]) -> None:  # fedlint: holds=_train_lock
+        entries = sorted(entries, key=lambda e: e[0])
+        variables = tree_from_wire(
+            ref_msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.template
+        )
+        round_idx = ref_msg.get(MSG_ARG_KEY_ROUND_INDEX)
+        codec_name = ref_msg.get(MSG_ARG_KEY_CODEC) or "none"
+        steps = ref_msg.get("steps_per_epoch")
+        # exactly the single-client identity derivation
+        # (FedAvgClientManager._on_sync): client = node - 1 on the
+        # shared multicast envelope, slot defaults to client_idx
+        client_ids: List[int] = []
+        slots: List[int] = []
+        for node, msg in entries:
+            ci = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
+            if ci is None:
+                ci = node - 1
+            client_ids.append(int(ci))
+            slots.append(int(msg.get("slot", ci)))
+        # id-keyed per-client pack seeding: row k of this cohort pack
+        # is bit-identical to client k's single-client pack
+        pack_key = (steps, self.batch_size)
+        if (self._pack_key != pack_key
+                or any(c not in self._pack_index for c in client_ids)):
+            base_ids = sorted(
+                set(client_ids) | {n - 1 for n in self.mux.node_ids}
+            )
+            pack = pack_clients(
+                self.dataset, base_ids, self.batch_size,
+                steps_per_epoch=steps, seed=self.seed,
+            )
+            self._pack_key = pack_key
+            self._pack_ids = base_ids
+            self._pack_index = {c: i for i, c in enumerate(base_ids)}
+            self._pack_host = (
+                np.asarray(pack.x), np.asarray(pack.y),
+                np.asarray(pack.mask),
+                np.asarray(pack.num_samples).copy(),
+            )
+            self._pack_dev = (
+                jnp.asarray(pack.x), jnp.asarray(pack.y),
+                jnp.asarray(pack.mask),
+            )
+        if client_ids == self._pack_ids:
+            # full-cohort steady state: the cached device arrays,
+            # no per-round copy
+            x, y, mask = self._pack_dev
+            num_samples = self._pack_host[3]
+        else:
+            rows = np.asarray(
+                [self._pack_index[c] for c in client_ids], np.int64
+            )
+            hx, hy, hm, hn = self._pack_host
+            x, y, mask = (jnp.asarray(hx[rows]), jnp.asarray(hy[rows]),
+                          jnp.asarray(hm[rows]))
+            num_samples = hn[rows]
+        # identical stream to the compiled round engine and the
+        # single-client manager: key→round→train→slot.  vmapped
+        # fold_in == the sequential per-client fold_in bit-for-bit
+        # (threefry is exact integer math — the sim engine leans on the
+        # same equivalence, algorithms/fedavg.py client_rngs).
+        k_round = jax.random.fold_in(
+            jax.random.PRNGKey(self.seed), round_idx
+        )
+        k_train = jax.random.fold_in(k_round, 0)
+        rngs = jax.vmap(
+            lambda s: jax.random.fold_in(k_train, s)
+        )(jnp.asarray(slots, jnp.int32))
+        new_stacked, metrics = self._cohort_update(
+            variables, x, y, mask, rngs,
+        )
+        # host-side views once per leaf; per-client rows slice from them
+        host_leaves = [np.asarray(l)
+                       for l in jax.tree_util.tree_leaves(new_stacked)]
+        treedef = jax.tree_util.tree_structure(new_stacked)
+        host_metrics = {k: np.asarray(v) for k, v in metrics.items()}
+        for k, (node, msg) in enumerate(entries):
+            new_vars = jax.tree_util.tree_unflatten(
+                treedef, [l[k] for l in host_leaves]
+            )
+            self._upload(node, msg, new_vars, variables, round_idx,
+                         codec_name, slots[k],
+                         float(num_samples[k]),
+                         {m: float(v[k]) for m, v in host_metrics.items()})
+            self.rounds_trained[node] += 1
+
+    def _upload(self, node: int, msg: Message, new_vars, synced_vars,
+                round_idx, codec_name: str, slot: int, n_samples: float,
+                metrics: dict) -> None:
+        from fedml_tpu.compress import wire_tree_digest
+        from fedml_tpu.obs import comm_obs
+
+        wire, raw, comp = encode_client_upload(
+            codec_name, new_vars, synced_vars, self.template,
+            seed=self.seed, round_idx=round_idx, slot=slot,
+            ef=ef_for(self._ef, node, codec_name, self.error_feedback),
+        )
+        if raw is not None:
+            comm_obs.record_compression(MSG_TYPE_C2S_SEND_MODEL, raw, comp)
+        self._hash[node].update(wire_tree_digest(wire).encode())
+        reply = Message(MSG_TYPE_C2S_SEND_MODEL, node, SERVER)
+        reply.add_params(MSG_ARG_KEY_ROUND_INDEX, round_idx)
+        reply.add_params(MSG_ARG_KEY_MODEL_PARAMS, wire)
+        reply.add_params(MSG_ARG_KEY_NUM_SAMPLES, n_samples)
+        reply.add_params(MSG_ARG_KEY_LOCAL_METRICS, metrics)
+        # through the per-virtual (possibly chaos-wrapped) backend:
+        # per-virtual-node send fault decisions + trace origin
+        try:
+            self._endpoints[node].send_message(reply)
+        except OSError:
+            # the shared conn died mid-cohort (after the backend's own
+            # bounded retries): this virtual client is a straggler this
+            # round; the remaining uploads still get their attempts —
+            # the reconnect path may revive the socket between them
+            logging.warning(
+                "muxer %d: upload for virtual node %d lost (connection "
+                "error) — deadline straggler", self.mux.node_id, node,
+            )
+
+    # -- lifecycle / evidence ----------------------------------------------
+    def run(self) -> None:
+        """Drive the shared reader loop (returns on FINISH/stop)."""
+        self.mux.run()
+
+    @property
+    def upload_digests(self) -> Dict[int, str]:
+        """node id -> accumulated sha256 of every upload it sent — the
+        same reproducibility probe ``FedAvgClientManager.upload_digest``
+        prints, one per virtual client."""
+        return {n: h.hexdigest() for n, h in self._hash.items()}

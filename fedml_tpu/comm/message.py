@@ -44,6 +44,13 @@ HUB_KEY = "__hub__"  # hub control frames (register/ack/ping/mcast/stop)
 # a large mcast payload into fixed-size stripes fanned round-robin
 # across connections; receivers reassemble (comm/tcp.py)
 MCAST_STRIPE_KIND = "mcast_stripe"
+# __hub__ kind of a muxed-delivery wrapper: a broadcast copy addressed
+# to SEVERAL virtual node ids that share one physical connection (hello
+# v2 registration).  The outer header names the target ids; the payload
+# is ONE complete inner frame the demuxing backend fans out locally
+# (comm/mux.py) — the shared payload crosses the wire once per
+# CONNECTION, never once per virtual node.
+MUX_KIND = "mux"
 FRAME_BINLEN_KEY = "__binlen__"  # header: raw payload bytes that follow
 FRAME_NDBUF_KEY = "__ndbuf__"  # header entry: [offset, nbytes] buffer ref
 WIRETREE_KEY = "__wiretree__"  # wire pytree envelope (version tag)

@@ -43,6 +43,7 @@ from fedml_tpu.comm.message import (
     FRAME_BINLEN_KEY,
     HUB_KEY,
     MCAST_STRIPE_KIND,
+    MUX_KIND,
     Message,
 )
 from fedml_tpu.obs import trace_ctx
@@ -150,7 +151,18 @@ def _sendall_parts(sock: socket.socket, parts) -> None:
 
 
 class _Conn:
-    """One registered node: socket + bounded outbound frame queue.
+    """One registered CONNECTION: socket + bounded outbound frame queue.
+
+    Since hello v2 a connection may carry MANY node ids (a muxer process
+    driving hundreds of virtual clients over one socket): ``ids`` is the
+    live set of node ids currently routed here (ids can be re-claimed by
+    a later connection — the rebind policy in ``_serve_conn``), ``mux``
+    marks a v2 registration (broadcast copies to it are wrapped in a
+    ``__hub__: mux`` outer header naming the co-located target ids so
+    the demuxing backend can fan out locally), ``cid`` is a small
+    process-unique connection id for per-connection telemetry series,
+    and ``dead`` tells a sender-pool worker to stop draining (set when
+    the reader exits or every id was rebound away).
 
     ``scheduled`` enforces a single drainer at a time (a connection is
     only ever serviced by the one sender worker it was handed to), so
@@ -158,14 +170,20 @@ class _Conn:
     mid-payload — the invariant the old per-conn send locks provided,
     now without serializing the fan-out behind the router thread.
 
-    Queue entries are ``(msg_type, parts, hdr, nbytes)``: for an
+    Queue entries are ``(msg_type, parts, hdr, nbytes, rids)``: for an
     untraced frame ``hdr`` is None and ``parts`` is the complete wire
     frame; for a TRACED frame ``hdr`` is the parsed header dict (shared
-    across an mcast's receiver queues) and ``parts`` holds only the
-    payload tail — the sender worker re-encodes the header line with a
-    fresh ``hub_out`` stamp at drain time, so ``hub_out - hub_in`` is
-    this frame's real queue wait and the payload bytes are still the
-    one shared immutable object.
+    across an mcast's receiver queues) — or a deferred ``(kind, meta,
+    inner header)`` tuple — and ``parts`` holds only the payload tail:
+    the sender worker re-encodes the header line with a fresh
+    ``hub_out`` stamp at drain time, so ``hub_out - hub_in`` is this
+    frame's real queue wait and the payload bytes are still the one
+    shared immutable object.  ``rids`` is the tuple of node ids the
+    entry addresses: the drain re-checks them against ``ids`` so a
+    frame queued for an id that was REBOUND to a newer connection
+    while waiting dies with straggler semantics instead of being
+    delivered to the displaced owner (the rebind policy's "old conn
+    loses it" must hold for in-flight frames too).
 
     ``heads`` is a strict-priority queue in front of ``frames``: a
     striped mcast enqueues every receiver's stripe 0 there, and a
@@ -178,14 +196,19 @@ class _Conn:
     this — tails land while heads are still draining and a paced visit
     would drain head+tail together)."""
 
-    __slots__ = ("sock", "frames", "heads", "nbytes", "scheduled")
+    __slots__ = ("sock", "frames", "heads", "nbytes", "scheduled",
+                 "ids", "mux", "cid", "dead")
 
-    def __init__(self, sock: socket.socket):
+    def __init__(self, sock: socket.socket, ids=(), mux: bool = False):
         self.sock = sock
-        self.frames: deque = deque()  # (msg_type, parts, hdr, nbytes)
+        self.frames: deque = deque()  # (msg_type, parts, hdr, nbytes, rids)
         self.heads: deque = deque()  # same entries, strict priority
         self.nbytes = 0
         self.scheduled = False
+        self.ids = set(ids)
+        self.mux = mux
+        self.cid = 0
+        self.dead = False
 
 
 class TcpHub:
@@ -211,6 +234,7 @@ class TcpHub:
         "mcast_copies": "_lock",
         "striped_mcasts": "_lock",
         "stripe_frames": "_lock",
+        "node_rebinds": "_lock",
     }
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
@@ -244,9 +268,20 @@ class TcpHub:
         self.backpressure_drops = 0
         self.mcast_frames = 0
         self.mcast_copies = 0
+        # duplicate-registration policy (pinned): a hello claiming an
+        # already-registered node id REBINDS it — the new connection
+        # wins, the old one loses the id (and is closed once it holds
+        # none), and every rebound id is counted here + as the
+        # ``hub.node_rebinds`` telemetry series.  Covers both the
+        # reconnect case (the old conn is half-dead) and a genuine
+        # two-live-conns conflict (last dialer wins, visibly).
+        self.node_rebinds = 0
         self._max_queue_bytes = max_queue_bytes
         self._max_queue_frames = max_queue_frames
+        # node id -> connection; MANY-TO-ONE since hello v2 (a muxer
+        # registers all its virtual node ids on one socket)
         self._conns: Dict[int, _Conn] = {}
+        self._cids = itertools.count(1)  # per-connection telemetry ids
         self._lock = make_lock("TcpHub._lock")
         self._ready: "queue.SimpleQueue" = queue.SimpleQueue()
         self._running = True
@@ -271,6 +306,7 @@ class TcpHub:
 
     def _serve_conn(self, conn: socket.socket):
         node_id = None
+        ids: List[int] = []
         st = None
         try:
             _tune_socket(conn)
@@ -278,7 +314,19 @@ class TcpHub:
             hello = f.readline()
             if not hello:
                 return
-            node_id = json.loads(hello)["node_id"]
+            hello_obj = json.loads(hello)
+            if "node_ids" in hello_obj:
+                # hello v2: one connection registers MANY node ids (a
+                # muxer's virtual clients); v1 dialers keep sending the
+                # single node_id form and both interop on one hub
+                ids = [int(i) for i in hello_obj["node_ids"]]
+                mux = True
+                if not ids:
+                    return  # empty registration: nothing to route
+            else:
+                ids = [int(hello_obj["node_id"])]
+                mux = False
+            node_id = ids[0]  # primary id: peers replies, logging
             # ACK BEFORE registering: once registered, the sender pool
             # may write to this conn concurrently, and an ACK
             # interleaved with a routed frame would hand the dialing
@@ -317,9 +365,43 @@ class TcpHub:
                 # pre-handshake peers (an old dialer): fall through to
                 # registration and let the main loop service this line
                 break
-            st = _Conn(conn)
+            st = _Conn(conn, ids=ids, mux=mux)
+            rebound: List[int] = []
+            stale_conns: List[_Conn] = []
             with self._lock:
-                self._conns[node_id] = st
+                st.cid = next(self._cids)
+                for nid in ids:
+                    old = self._conns.get(nid)
+                    if old is not None and old is not st:
+                        # rebind policy (pinned): the NEW conn wins the
+                        # id; the old conn loses it and dies entirely
+                        # once it holds no ids — counted, never silent
+                        self.node_rebinds += 1
+                        rebound.append(nid)
+                        old.ids.discard(nid)
+                        if not old.ids:
+                            old.dead = True
+                            stale_conns.append(old)
+                    self._conns[nid] = st
+            tel = get_telemetry()
+            for nid in rebound:
+                tel.inc("hub.node_rebinds")
+                logging.warning(
+                    "hub: node %s re-registered on a new connection — "
+                    "the old connection loses it (rebind)", nid,
+                )
+            for old in stale_conns:
+                # drop the fully-displaced conn: its reader sees EOF and
+                # cleans up; queued frames die with it (straggler
+                # semantics, same as any dead receiver)
+                try:
+                    old.sock.shutdown(socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                try:
+                    old.sock.close()
+                except OSError:
+                    pass
             pending = None if frame.get(HUB_KEY) == "ping_done" \
                 else (line, frame)
             while True:
@@ -365,40 +447,73 @@ class TcpHub:
                     if not payload:
                         logging.warning("hub: mcast frame without payload")
                         continue
+                    # per-conn dedup FIRST: receivers sharing a muxed
+                    # connection collapse to ONE wrapped copy per
+                    # connection; mcast_copies counts the physical
+                    # copies actually enqueued (== receivers for v1
+                    # dialers, == connections under muxing)
+                    groups, unknown = self._conn_groups(receivers)
+                    for r in unknown:
+                        self._count_drop(r, mt)
                     with self._lock:
                         self.mcast_frames += 1
-                        self.mcast_copies += len(receivers)
+                        self.mcast_copies += len(groups)
                     get_telemetry().inc("hub.mcast_frames",
                                         msg_type=mt or "?")
                     if (self._stripe_bytes
                             and len(payload) > self._stripe_bytes
                             and len(payload) <= _MAX_REASM_BYTES // 2):
-                        self._fan_out_striped(frame, receivers, mt, payload)
+                        self._fan_out_striped(frame, groups, mt, payload)
                         continue
                     # traced mcast (outer header flags it): split the
                     # inner frame at its header line ONCE, stamp hub_in,
                     # and queue (parsed header, shared payload-tail
                     # view) per receiver — the sender worker re-encodes
                     # the small header per copy with its own hub_out
-                    # stamp while the multi-MB tail stays one object
+                    # stamp while the multi-MB tail stays one object.
+                    # Mux wraps (traced AND untraced) are DEFERRED
+                    # (kind, meta, hdr) entries: the worker builds the
+                    # outer line at drain, filtering the target nodes
+                    # against the conn's live id set — a rebind while
+                    # the copy waits must not be fanned out to the
+                    # stolen id by the displaced owner.
                     hdr, tail = _split_traced_mcast(frame, payload)
-                    for r in receivers:
-                        if hdr is not None:
-                            self._forward(r, (tail,), msg_type=mt,
-                                          hdr=hdr, nbytes=len(payload))
-                        else:
-                            self._forward(r, (payload,), msg_type=mt)
+                    for cst, rids in groups:
+                        if not cst.mux:
+                            # plain single-id conn: the pre-mux path
+                            if hdr is not None:
+                                self._forward(rids[0], (tail,),
+                                              msg_type=mt, hdr=hdr,
+                                              nbytes=len(payload),
+                                              conn=cst)
+                            else:
+                                self._forward(rids[0], (payload,),
+                                              msg_type=mt, conn=cst)
+                            continue
+                        body = (tail,) if hdr is not None else (payload,)
+                        ok = self._forward(
+                            rids[0], body, msg_type=mt,
+                            hdr=(MUX_KIND,
+                                 {"nodes": rids, "msg_type": mt}, hdr),
+                            nbytes=len(payload), rids=rids, conn=cst)
+                        if not ok:
+                            # _forward counted the representative id;
+                            # the co-located rest lost the same copy
+                            for r in rids[1:]:
+                                self._count_drop(r, mt)
                     continue
                 if frame.get(HUB_KEY) == "peers":
                     # membership introspection: reply to THIS node with
                     # the currently registered ids (startup barrier —
                     # frames to unregistered receivers are dropped, so
-                    # coordinators must await their cohort first)
+                    # coordinators must await their cohort first).
+                    # NOT named ``ids``: that local is THIS conn's
+                    # hello id list, which the cleanup block iterates
                     with self._lock:
-                        ids = sorted(self._conns)
+                        peer_ids = sorted(self._conns)
                     self._forward(
                         node_id,
-                        ((json.dumps({HUB_KEY: "peers", "ids": ids})
+                        ((json.dumps({HUB_KEY: "peers", "ids": peer_ids})
                           + "\n").encode(),),
                     )
                     continue
@@ -423,53 +538,88 @@ class TcpHub:
         except OSError:
             pass  # peer vanished: fall through to cleanup
         finally:
-            if node_id is not None and st is not None:
+            if st is not None:
                 with self._lock:
+                    st.dead = True
                     # identity guard: a re-registered node may have
-                    # replaced this conn; don't deregister the live one
-                    if self._conns.get(node_id) is st:
-                        self._conns.pop(node_id, None)
+                    # been rebound to a newer conn; deregister only the
+                    # ids still mapping HERE
+                    for nid in ids:
+                        if self._conns.get(nid) is st:
+                            self._conns.pop(nid, None)
             try:
                 conn.close()
             except OSError:
                 pass
 
+    def _conn_groups(self, receivers):
+        """Group a receiver-id list by physical connection (the mcast
+        per-conn dedup): ``([(conn, [ids...]), ...], [unknown ids])`` in
+        first-appearance order.  A broadcast to 500 co-located virtual
+        clients then ships the shared payload once per CONNECTION, not
+        once per node."""
+        groups: List[tuple] = []
+        by_conn: Dict[int, tuple] = {}
+        unknown: List[int] = []
+        with self._lock:
+            for r in receivers:
+                st = self._conns.get(r)
+                if st is None:
+                    unknown.append(r)
+                    continue
+                key = id(st)
+                ent = by_conn.get(key)
+                if ent is None:
+                    ent = by_conn[key] = (st, [])
+                    groups.append(ent)
+                ent[1].append(r)
+        return groups, unknown
+
     def _forward(self, receiver: int, parts: Tuple, msg_type=None,
-                 hdr=None, nbytes=None):
+                 hdr=None, nbytes=None, rids=None, conn=None) -> bool:
         """Enqueue one frame for ``receiver``; the sender pool writes
         it.  Untraced (``hdr=None``): ``parts`` is the COMPLETE frame
         (header line [+ payload]).  Traced: ``hdr`` is the parsed
         header dict (already ``hub_in``-stamped; shared across an
-        mcast's receiver queues) and ``parts`` holds only the payload
-        tail — the sender worker re-encodes the header line at drain
-        time.  Unknown receivers and over-bound queues drop the frame —
+        mcast's receiver queues) — or a deferred ``(kind, meta, inner
+        header)`` tuple — and ``parts`` holds only the payload tail:
+        the sender worker re-encodes the header line at drain time.
+        Unknown receivers and over-bound queues drop the frame —
         counted, by design (the round deadline treats the receiver as a
-        straggler)."""
+        straggler).  Returns False when the frame was dropped (mux-group
+        callers count the co-located ids the same drop cost).
+
+        ``conn`` pins the target connection (mcast group paths resolve
+        it ONCE in ``_conn_groups``): re-resolving by id here could
+        land a mux-wrapped copy on a connection that REBOUND the
+        representative id in between — the wrong peer entirely."""
         if nbytes is None:
             nbytes = sum(len(p) for p in parts)
         wake = False
         dropped = False
         with self._lock:
-            st = self._conns.get(receiver)
-            if st is None:
+            st = conn if conn is not None else self._conns.get(receiver)
+            if st is None or st.dead:
                 dropped = True
             elif (len(st.frames) + len(st.heads) >= self._max_queue_frames
                     or st.nbytes + nbytes > self._max_queue_bytes):
                 self.backpressure_drops += 1
                 dropped = True
             else:
-                st.frames.append((msg_type, parts, hdr, nbytes))
+                st.frames.append((msg_type, parts, hdr, nbytes,
+                                  tuple(rids) if rids else (receiver,)))
                 st.nbytes += nbytes
                 if not st.scheduled:
                     st.scheduled = True
                     wake = True
         if dropped:
             self._count_drop(receiver, msg_type)
-            return
+            return False
         if wake:
             self._ready.put((receiver, st))
+        return True
 
-    def _fan_out_striped(self, frame: dict, receivers, mt,
+    def _fan_out_striped(self, frame: dict, groups, mt,
                          payload: bytes) -> None:
         """Split one mcast payload into ``mcast_stripe`` frames and
         enqueue the stripe sequence to every receiver.
@@ -493,27 +643,28 @@ class TcpHub:
         chunks = [body[i:i + self._stripe_bytes]
                   for i in range(0, len(body), self._stripe_bytes)]
         total = len(chunks) + (1 if hdr is not None else 0)
-        entries: List[tuple] = []
-        if hdr is not None:
-            # deferred stripe 0: (kind, outer meta, inner header dict) —
-            # the worker builds outer line + restamped inner line at
-            # drain.  nbytes is the original line's length (queue
-            # accounting only; the restamp grows it by one hop).
-            meta0 = {"sid": sid, "i": 0, "n": total, "msg_type": mt}
-            entries.append((mt, (), (MCAST_STRIPE_KIND, meta0, hdr),
-                            len(payload) - len(body) + 64))
         base = 1 if hdr is not None else 0
-        for k, ch in enumerate(chunks):
-            outer = (json.dumps({
-                HUB_KEY: MCAST_STRIPE_KIND, "sid": sid, "i": base + k,
-                "n": total, "msg_type": mt, "crc": zlib.crc32(ch),
-                FRAME_BINLEN_KEY: len(ch),
-            }) + "\n").encode()
-            entries.append((mt, (outer, ch), None, len(outer) + len(ch)))
+
+        def chunk_entry(k: int) -> tuple:
+            ch = chunks[k]
+            h = {HUB_KEY: MCAST_STRIPE_KIND, "sid": sid, "i": base + k,
+                 "n": total, "msg_type": mt, "crc": zlib.crc32(ch),
+                 FRAME_BINLEN_KEY: len(ch)}
+            outer = (json.dumps(h) + "\n").encode()
+            return (mt, (outer, ch), None, len(outer) + len(ch))
+
+        # tail entries are shared tuples across every connection's
+        # queue (same immutable buffers); only stripe 0 is per-conn —
+        # a muxed conn's carries the co-located ``nodes`` list the
+        # demuxing backend fans the reassembled frame out to
+        if hdr is not None:
+            tails = [chunk_entry(k) for k in range(len(chunks))]
+        else:
+            tails = [chunk_entry(k) for k in range(1, len(chunks))]
         with self._lock:
             self.striped_mcasts += 1
-        # head-start scheduling: EVERY receiver's stripe 0 rides the
-        # strict-priority head queue, so the pool drains all K head
+        # head-start scheduling: EVERY connection's stripe 0 rides the
+        # strict-priority head queue, so the pool drains all head
         # stripes (small) before any tail — every receiver starts
         # streaming within one head round (bcast_queue ≈ that round,
         # not K-1 whole-frame sends) — and then drains tails at
@@ -522,26 +673,58 @@ class TcpHub:
         # work with the rest of the fan-out (measured: full round-robin
         # equalizes completion and serializes the cohort's post-receive
         # compute AFTER the fan-out window; see PROFILE.md round-9).
-        for r in receivers:
-            self._forward_stripes(r, entries[:1], mt, head=True)
-        for r in receivers:
-            self._forward_stripes(r, entries[1:], mt)
+        for cst, rids in groups:
+            if hdr is not None:
+                # deferred stripe 0: (kind, outer meta, inner header
+                # dict) — the worker builds outer line + restamped
+                # inner line at drain.  nbytes is the original line's
+                # length (queue accounting only; the restamp grows it
+                # by one hop).
+                meta0 = {"sid": sid, "i": 0, "n": total, "msg_type": mt}
+                if cst.mux:
+                    meta0["nodes"] = rids
+                head_entry = (mt, (), (MCAST_STRIPE_KIND, meta0, hdr),
+                              len(payload) - len(body) + 64)
+            elif cst.mux:
+                # untraced mux stripe 0 is ALSO deferred: the worker
+                # re-encodes the small outer header at drain with the
+                # ``nodes`` list filtered to the conn's live ids (a
+                # rebind mid-queue-wait must drop the stolen id from
+                # the local fan-out); the chunk rides as parts
+                ch0 = chunks[0]
+                meta0 = {"sid": sid, "i": 0, "n": total, "msg_type": mt,
+                         "crc": zlib.crc32(ch0), "nodes": rids}
+                head_entry = (mt, (ch0,), (MCAST_STRIPE_KIND, meta0,
+                                           None), len(ch0) + 96)
+            else:
+                head_entry = chunk_entry(0)
+            self._forward_stripes(cst, rids, [head_entry], mt, head=True)
+        for cst, rids in groups:
+            self._forward_stripes(cst, rids, tails, mt)
 
-    def _forward_stripes(self, receiver: int, entries: List[tuple],
-                         msg_type, head: bool = False) -> None:
+    def _forward_stripes(self, conn: _Conn, receivers: List[int],
+                         entries: List[tuple], msg_type,
+                         head: bool = False) -> None:
         """Enqueue one segment of a logical frame's stripe sequence
-        atomically (all or nothing): an over-bound queue drops the
-        whole segment in one counted decision — the receiver then sees
-        an index gap (tail dropped after its head) or nothing at all,
-        and either way the logical frame dies with straggler semantics
-        instead of wedging reassembly (a gap aborts the stream; a
-        head with no tail is evicted by the bounded-stream cap)."""
+        atomically (all or nothing) onto the PRE-RESOLVED connection
+        ``receivers`` share: an over-bound queue drops the whole
+        segment in one counted decision — the receiver then sees an
+        index gap (tail dropped after its head) or nothing at all, and
+        either way the logical frame dies with straggler semantics
+        instead of wedging reassembly (a gap aborts the stream; a head
+        with no tail is evicted by the bounded-stream cap)."""
         nbytes = sum(e[3] for e in entries)
         wake = False
         dropped = False
+        receiver = receivers[0]
+        # tag the (shared-buffer) entries with this connection's target
+        # ids — the drain's rebind re-check needs them; the buffers
+        # themselves stay shared across connections
+        rids = tuple(receivers)
+        tagged = [(e[0], e[1], e[2], e[3], rids) for e in entries]
         with self._lock:
-            st = self._conns.get(receiver)
-            if st is None:
+            st = conn
+            if st.dead:
                 dropped = True
             elif (len(st.frames) + len(st.heads) + len(entries)
                     > self._max_queue_frames
@@ -549,14 +732,15 @@ class TcpHub:
                 self.backpressure_drops += 1
                 dropped = True
             else:
-                (st.heads if head else st.frames).extend(entries)
+                (st.heads if head else st.frames).extend(tagged)
                 st.nbytes += nbytes
                 self.stripe_frames += len(entries)
                 if not st.scheduled:
                     st.scheduled = True
                     wake = True
         if dropped:
-            self._count_drop(receiver, msg_type)
+            for r in receivers:
+                self._count_drop(r, msg_type)
             return
         if wake:
             self._ready.put((receiver, st))
@@ -586,14 +770,27 @@ class TcpHub:
             while True:
                 requeue = False
                 from_head = False
+                stale_rids = False
+                live_nodes = None  # filtered mux/stripe-0 target list
+                stale_subset: Tuple = ()
+                dead_leftovers = None
                 with self._lock:
-                    if self._conns.get(nid) is not st:
-                        break  # replaced/deregistered: frames die with it
-                    if st.heads:
+                    if st.dead:
+                        # replaced/deregistered: frames die with it —
+                        # COUNTED, like the OSError path's leftovers
+                        # (the rebind policy promises visible drops)
+                        dead_leftovers = [(e[0], e[4]) for e in st.heads]
+                        dead_leftovers += [(e[0], e[4])
+                                           for e in st.frames]
+                        st.heads.clear()
+                        st.frames.clear()
+                        st.nbytes = 0
+                    elif st.heads:
                         # strict priority, quantum-exempt: heads are
                         # small and the head-start contract wants all
                         # of them out before any conn's tail
-                        msg_type, parts, hdr, nbytes = st.heads.popleft()
+                        msg_type, parts, hdr, nbytes, rids = \
+                            st.heads.popleft()
                         st.nbytes -= nbytes
                         from_head = True
                     elif not st.frames:
@@ -602,8 +799,35 @@ class TcpHub:
                     elif quantum >= self._pace:
                         requeue = True
                     else:
-                        msg_type, parts, hdr, nbytes = st.frames.popleft()
+                        msg_type, parts, hdr, nbytes, rids = \
+                            st.frames.popleft()
                         st.nbytes -= nbytes
+                    if not requeue and dead_leftovers is None:
+                        # rebind re-check: any id this entry targets
+                        # may have been claimed by a NEWER connection
+                        # while the frame sat queued — the displaced
+                        # owner must not deliver to it (straggler
+                        # drop, exactly the policy's "old conn loses
+                        # it").  Deferred mux/stripe-0 entries carry
+                        # their target list in ``meta['nodes']`` and
+                        # get it FILTERED to the live subset (the
+                        # outer header is rebuilt at drain anyway);
+                        # whole entries drop only when every target is
+                        # gone.
+                        if rids:
+                            stale_subset = tuple(
+                                r for r in rids if r not in st.ids)
+                            if len(stale_subset) == len(rids):
+                                stale_rids = True
+                            elif stale_subset and isinstance(hdr, tuple) \
+                                    and hdr[1].get("nodes"):
+                                live_nodes = [r for r in rids
+                                              if r in st.ids]
+                if dead_leftovers is not None:
+                    for mt_, rids_ in dead_leftovers:
+                        for r in rids_ or ():
+                            self._count_drop(r, mt_)
+                    break
                 if requeue:
                     self._ready.put((nid, st))
                     break
@@ -612,20 +836,51 @@ class TcpHub:
                 # other conn's pending head drains first (the requeue
                 # lands behind them in the FIFO ready queue)
                 quantum = self._pace if from_head else quantum + 1
+                if stale_rids:
+                    # every id this entry addressed was rebound away
+                    for r in rids:
+                        self._count_drop(r, msg_type)
+                    continue
+                if live_nodes is not None:
+                    # partially-rebound mux copy: the stolen ids lose
+                    # this frame (counted), the live ones still get it
+                    for r in stale_subset:
+                        self._count_drop(r, msg_type)
                 try:
                     if isinstance(hdr, tuple):
-                        # deferred traced stripe 0: build the outer
-                        # stripe header + the inner header line with
-                        # THIS copy's hub_out stamp, crc over the line
-                        # actually sent
-                        _, meta, inner_hdr = hdr
-                        line = trace_ctx.hub_out_line(inner_hdr)
-                        outer = (json.dumps({
-                            HUB_KEY: MCAST_STRIPE_KIND, **meta,
-                            "crc": zlib.crc32(line),
-                            FRAME_BINLEN_KEY: len(line),
-                        }) + "\n").encode()
-                        _sendall_parts(st.sock, [outer, line])
+                        # deferred copy: build the outer header at
+                        # drain time — around the hub_out-restamped
+                        # inner header line when traced (inner_hdr set),
+                        # around the raw body otherwise.  Two kinds:
+                        # stripe 0 of a striped mcast and a mux wrap.
+                        # ``live_nodes`` (rebind filtering) replaces
+                        # the meta's target list when set.
+                        kind, meta, inner_hdr = hdr
+                        if live_nodes is not None:
+                            meta = {**meta, "nodes": live_nodes}
+                        if inner_hdr is not None:
+                            line = trace_ctx.hub_out_line(inner_hdr)
+                            body = [line, *parts]
+                        else:
+                            line = None
+                            body = list(parts)
+                        if kind == MUX_KIND:
+                            outer = (json.dumps({
+                                HUB_KEY: MUX_KIND, **meta,
+                                FRAME_BINLEN_KEY: sum(
+                                    len(p) for p in body),
+                            }) + "\n").encode()
+                        else:
+                            out_hdr = {HUB_KEY: MCAST_STRIPE_KIND,
+                                       **meta}
+                            if line is not None:
+                                # traced stripe 0: the restamped line
+                                # IS the chunk — crc what actually ships
+                                out_hdr["crc"] = zlib.crc32(line)
+                            out_hdr[FRAME_BINLEN_KEY] = sum(
+                                len(p) for p in body)
+                            outer = (json.dumps(out_hdr) + "\n").encode()
+                        _sendall_parts(st.sock, [outer, *body])
                     elif hdr is not None:
                         # traced frame: re-encode the (small) header
                         # line with THIS copy's hub_out stamp at drain
@@ -643,15 +898,18 @@ class TcpHub:
                     # cleanup when it sees EOF)
                     self._count_drop(nid, msg_type)
                     with self._lock:
-                        if self._conns.get(nid) is st:
-                            self._conns.pop(nid, None)
-                        leftovers = [e[0] for e in st.heads]
-                        leftovers += [e[0] for e in st.frames]
+                        st.dead = True
+                        for i in list(st.ids):
+                            if self._conns.get(i) is st:
+                                self._conns.pop(i, None)
+                        leftovers = [(e[0], e[4]) for e in st.heads]
+                        leftovers += [(e[0], e[4]) for e in st.frames]
                         st.heads.clear()
                         st.frames.clear()
                         st.nbytes = 0
-                    for mt in leftovers:
-                        self._count_drop(nid, mt)
+                    for mt_, rids_ in leftovers:
+                        for r in rids_ or (nid,):
+                            self._count_drop(r, mt_)
                     break
                 except Exception:
                     # never lose a pool worker to an unexpected bug —
@@ -673,61 +931,79 @@ class TcpHub:
         logging.debug("hub: dropped %s frame to unreachable node %s",
                       mt, receiver)
 
+    def _counters_snapshot(self) -> dict:  # fedlint: holds=_lock
+        assert_held(self._lock, "TcpHub._counters_snapshot")
+        return {
+            "dropped_frames": dict(self.dropped_frames),
+            "backpressure_drops": self.backpressure_drops,
+            "mcast_frames": self.mcast_frames,
+            "mcast_copies": self.mcast_copies,
+            "striped_mcasts": self.striped_mcasts,
+            "stripe_frames": self.stripe_frames,
+            "node_rebinds": self.node_rebinds,
+        }
+
     def stats(self) -> dict:
         """Hub-side fault + fan-out accounting (``run_hub`` prints this
-        at shutdown so multi-process chaos drivers can collect it)."""
+        at shutdown so multi-process chaos drivers can collect it).
+        ``nodes`` counts registered node ids, ``connections`` physical
+        sockets — equal for v1 dialers, many-to-one under muxing."""
         with self._lock:
-            return {
-                "dropped_frames": dict(self.dropped_frames),
-                "backpressure_drops": self.backpressure_drops,
-                "mcast_frames": self.mcast_frames,
-                "mcast_copies": self.mcast_copies,
-                "striped_mcasts": self.striped_mcasts,
-                "stripe_frames": self.stripe_frames,
-            }
+            snap = self._counters_snapshot()
+            snap["nodes"] = len(self._conns)
+            snap["connections"] = len(set(map(id, self._conns.values())))
+        return snap
 
     def sample_telemetry(self, telemetry=None) -> dict:
-        """Snapshot ``stats()`` + per-connection send-queue depths into
+        """Snapshot ``stats()`` + per-CONNECTION send-queue depths into
         the telemetry registry: gauges for live introspection plus one
-        ``hub_stats`` event per call.  ``run_hub`` calls this on a
-        timer and drains into ``metrics-hub.jsonl``, so a crashed or
-        SIGKILLed hub still leaves queue-depth / backpressure evidence
-        behind as a time series (the old behavior only printed stats at
-        a GRACEFUL exit) — and the per-sample ``t_m`` monotonic stamp
-        lets ``tools/fed_timeline.py`` line queue depth up against the
+        ``hub_stats`` event per call.  Queue depth and backpressure are
+        physical-connection properties (a muxer's 500 virtual node ids
+        share ONE queue), so the series are keyed by connection id with
+        a ``hub.conn_nodes`` gauge carrying each connection's node
+        count and a ``hub.nodes`` total alongside — node-keyed copies
+        of the same number would overstate a muxed hub's queue memory
+        500x.  ``run_hub`` calls this on a timer and drains into
+        ``metrics-hub.jsonl``, so a crashed or SIGKILLed hub still
+        leaves queue-depth / backpressure evidence behind as a time
+        series — and the per-sample ``t_m`` monotonic stamp lets
+        ``tools/fed_timeline.py`` line queue depth up against the
         per-frame hop stamps, which share this clock."""
         t = telemetry or get_telemetry()
         with self._lock:
-            depths = {nid: (len(st.frames) + len(st.heads), st.nbytes)
-                      for nid, st in self._conns.items()}
-            snap = {
-                "dropped_frames": dict(self.dropped_frames),
-                "backpressure_drops": self.backpressure_drops,
-                "mcast_frames": self.mcast_frames,
-                "mcast_copies": self.mcast_copies,
-                "striped_mcasts": self.striped_mcasts,
-                "stripe_frames": self.stripe_frames,
-            }
-        for nid, (nframes, nbytes) in depths.items():
-            t.gauge_set("hub.send_queue_frames", nframes, node=nid)
-            t.gauge_set("hub.send_queue_bytes", nbytes, node=nid)
+            depths = {}
+            nodes_total = len(self._conns)
+            for st in set(self._conns.values()):
+                depths[st.cid] = (len(st.frames) + len(st.heads),
+                                  st.nbytes, len(st.ids))
+            snap = self._counters_snapshot()
+        for cid, (nframes, nbytes, nids) in depths.items():
+            t.gauge_set("hub.send_queue_frames", nframes, conn=cid)
+            t.gauge_set("hub.send_queue_bytes", nbytes, conn=cid)
+            t.gauge_set("hub.conn_nodes", nids, conn=cid)
         t.gauge_set("hub.connections", len(depths))
+        t.gauge_set("hub.nodes", nodes_total)
         # _total suffix = cumulative monotonic counter exposed as a time
         # series (diff successive samples for a rate); un-suffixed hub
-        # gauges (connections, send_queue_*) are instantaneous.  mcast
-        # copies lose their identity once queued, so no true in-flight
-        # mcast count exists to report
+        # gauges (connections, nodes, send_queue_*) are instantaneous.
+        # mcast copies lose their identity once queued, so no true
+        # in-flight mcast count exists to report
         t.gauge_set("hub.backpressure_drops_total",
                     snap["backpressure_drops"])
         t.gauge_set("hub.mcast_frames_total", snap["mcast_frames"])
         t.gauge_set("hub.stripe_frames_total", snap["stripe_frames"])
+        t.gauge_set("hub.node_rebinds_total", snap["node_rebinds"])
         t.event(
             "hub_stats", t_m=trace_ctx.now(),
             connections=sorted(depths),
-            queue_frames={str(n): d[0] for n, d in depths.items()},
-            queue_bytes={str(n): d[1] for n, d in depths.items()},
+            nodes=nodes_total,
+            queue_frames={str(c): d[0] for c, d in depths.items()},
+            queue_bytes={str(c): d[1] for c, d in depths.items()},
+            conn_nodes={str(c): d[2] for c, d in depths.items()},
             **snap,
         )
+        snap["nodes"] = nodes_total
+        snap["connections"] = len(depths)
         return snap
 
     def stop(self):
@@ -823,6 +1099,12 @@ class TcpBackend(CommBackend):
         with self._reasm_lock:
             self._stripe_fault_hook = hook
 
+    def _hello_line(self) -> bytes:
+        """Registration line sent on dial.  v1: one ``node_id``.  The
+        muxed subclass overrides with the hello-v2 ``node_ids`` form
+        (``comm/mux.py``); the hub accepts both on one port."""
+        return (json.dumps({"node_id": self.node_id}) + "\n").encode()
+
     def _dial(self):
         with self._send_lock:
             sock = socket.create_connection(
@@ -830,9 +1112,7 @@ class TcpBackend(CommBackend):
             )
             _tune_socket(sock)
             try:
-                sock.sendall(
-                    (json.dumps({"node_id": self.node_id}) + "\n").encode()
-                )
+                sock.sendall(self._hello_line())
                 f = sock.makefile("rb")
                 # wait for the hub's registration ACK — guaranteed to be
                 # the FIRST line on the conn (the hub ACKs before
@@ -939,21 +1219,30 @@ class TcpBackend(CommBackend):
                 delay = min(delay * 2.0, 2.0)
 
     def send_message(self, msg: Message) -> None:
-        # v2: header line + raw buffer views (to_frame_parts, memoized
-        # on the message); v1: one JSON line (newlines escape inside
-        # JSON strings) — either way ONE complete frame, written
-        # atomically (vectored) under the send lock
+        self._send_message_as(msg, self.node_id)
+
+    def _send_message_as(self, msg: Message, origin: int) -> None:
+        """One frame onto the shared socket, trace-stamped as coming
+        from ``origin`` — the node_id for a plain backend, the VIRTUAL
+        node id when a muxed connection sends on a virtual client's
+        behalf (``comm/mux.py``), so per-virtual-node hop chains stay
+        distinguishable over one physical conn.
+
+        v2: header line + raw buffer views (to_frame_parts, memoized
+        on the message); v1: one JSON line (newlines escape inside
+        JSON strings) — either way ONE complete frame, written
+        atomically (vectored) under the send lock."""
         t0 = time.perf_counter()
-        trace_ctx.ensure(msg, self.node_id)
+        trace_ctx.ensure(msg, origin)
         if self.wire >= 2:
             # restamp_parts re-encodes ONLY the header line around the
             # memoized encoding (payload views shared by identity) — a
             # no-op returning the memoized list when untraced
             parts = trace_ctx.restamp_parts(
-                msg, msg.to_frame_parts(), self.node_id, "send"
+                msg, msg.to_frame_parts(), origin, "send"
             )
         else:
-            trace_ctx.stamp_msg(msg, self.node_id, "send")
+            trace_ctx.stamp_msg(msg, origin, "send")
             parts = [(msg.to_json() + "\n").encode()]
         self._send_parts(parts, msg.type)
         # exact wire bytes; latency covers serialize + socket write
@@ -1173,6 +1462,16 @@ class TcpBackend(CommBackend):
                     logging.exception("node %d: stripe reassembly failed",
                                       self.node_id)
                 continue
+            if frame.get(HUB_KEY) == MUX_KIND:
+                try:
+                    self._on_mux_frame(frame, payload,
+                                       nbytes=len(line) + len(payload))
+                except Exception:
+                    # a demux bug must degrade to a dropped broadcast
+                    # copy, never a dead reader
+                    logging.exception("node %d: mux demux failed",
+                                      self.node_id)
+                continue
             try:
                 # exact wire bytes: header line + binary payload
                 self._notify(Message.from_frame(frame, payload),
@@ -1231,8 +1530,12 @@ class TcpBackend(CommBackend):
                 ent = None
             if abort_reason is None:
                 if ent is None:
+                    # ``nodes`` rides stripe 0's outer header on a
+                    # muxed connection: the co-located virtual node ids
+                    # the reassembled frame fans out to locally
                     ent = {"chunks": [], "next": 0, "total": total,
-                           "t0": t_now, "nbytes": 0, "blen": 0, "mt": mt}
+                           "t0": t_now, "nbytes": 0, "blen": 0, "mt": mt,
+                           "nodes": frame.get("nodes")}
                     self._reasm[sid] = ent
                 if idx != ent["next"] or total != ent["total"]:
                     abort_reason = "gap"
@@ -1298,10 +1601,27 @@ class TcpBackend(CommBackend):
             )
             return
         tel.inc("comm.stripe_reassemblies", msg_type=mt)
-        # backdated hop: reassembly started at first-stripe arrival —
-        # recv - reasm is the reassembly/streaming wait on this node
-        trace_ctx.stamp_msg(msg, self.node_id, "reasm", t=done["t0"])
-        self._notify(msg, nbytes=done["nbytes"])
+        self._deliver_reassembled(msg, done)
+
+    def _deliver_reassembled(self, msg: Message, ent: dict) -> None:
+        """Hand a reassembled logical frame to the observers.  The
+        muxed backend overrides to fan out per co-located virtual node
+        (``ent['nodes']``); here the backdated ``reasm`` hop marks
+        first-stripe arrival — recv - reasm is the reassembly/streaming
+        wait on this node."""
+        trace_ctx.stamp_msg(msg, self.node_id, "reasm", t=ent["t0"])
+        self._notify(msg, nbytes=ent["nbytes"])
+
+    def _on_mux_frame(self, frame: dict, payload: bytes,
+                      nbytes: int) -> None:
+        """A ``__hub__: mux`` wrapped broadcast copy.  Only muxed
+        backends (hello v2) are ever addressed with these; a plain
+        backend receiving one is a hub bug — drop it loudly (straggler
+        semantics: the round deadline covers the lost sync)."""
+        logging.warning(
+            "node %d: unexpected mux-wrapped frame (%s) on a non-muxed "
+            "connection — dropped", self.node_id, frame.get("msg_type"),
+        )
 
     def run_in_thread(self) -> threading.Thread:
         t = threading.Thread(target=self.run, daemon=True)

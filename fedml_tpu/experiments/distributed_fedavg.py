@@ -69,19 +69,38 @@ def _build_problem(seed: int, num_clients: int, input_dim: int = 8,
     return ds, bundle, init, lu
 
 
-def _connect_backend(node_id: int, host: str, port: int, retries: int = 50,
-                     auto_reconnect: int = 0, wire: int = 2):
-    """The hub may still be binding when a worker starts: retry."""
-    from fedml_tpu.comm.tcp import TcpBackend
-
+def _dial_with_retry(factory, retries: int = 50):
+    """The hub may still be binding when a worker starts: retry the
+    backend constructor — ONE retry policy for every dialing role."""
     for attempt in range(retries):
         try:
-            return TcpBackend(node_id, host, port,
-                              auto_reconnect=auto_reconnect, wire=wire)
+            return factory()
         except (ConnectionError, OSError):
             if attempt == retries - 1:
                 raise
             time.sleep(0.1)
+
+
+def _connect_backend(node_id: int, host: str, port: int, retries: int = 50,
+                     auto_reconnect: int = 0, wire: int = 2):
+    from fedml_tpu.comm.tcp import TcpBackend
+
+    return _dial_with_retry(
+        lambda: TcpBackend(node_id, host, port,
+                           auto_reconnect=auto_reconnect, wire=wire),
+        retries)
+
+
+def _connect_mux_backend(node_ids, host: str, port: int, retries: int = 50,
+                         auto_reconnect: int = 0, wire: int = 2):
+    """Muxed twin of ``_connect_backend``: one hello-v2 dial registers
+    the whole virtual-client range."""
+    from fedml_tpu.comm.mux import TcpMuxBackend
+
+    return _dial_with_retry(
+        lambda: TcpMuxBackend(node_ids, host, port,
+                              auto_reconnect=auto_reconnect, wire=wire),
+        retries)
 
 
 def _chaos_plan():
@@ -105,10 +124,13 @@ def _maybe_chaos(backend, role: str, plan=None):
 
 def _collect_json_lines(stream, info: dict) -> None:
     """Fold every parseable JSON line of a finished process's stdout
-    into ``info`` (server fault counters, hub stats)."""
+    into ``info`` (server fault counters, hub stats, per-client upload
+    digests).  ``stream`` may also be already-read text (the muxer
+    path drains via ``communicate`` — see ``launch``)."""
     if stream is None:
         return
-    for line in stream.read().splitlines():
+    text = stream if isinstance(stream, str) else stream.read()
+    for line in text.splitlines():
         try:
             info.update(json.loads(line))
         except json.JSONDecodeError:
@@ -378,6 +400,73 @@ def run_client(args) -> None:
     }), flush=True)
 
 
+def run_muxer(args) -> None:
+    """ONE process driving ``--virtual-clients`` virtual clients over
+    ONE hub connection (node ids ``--node-id .. --node-id + N - 1``):
+    hello-v2 registration, local demux of per-connection broadcast
+    copies, and one vmapped jit step per round's co-located cohort —
+    the process-per-client decoupling ROADMAP item 2 asks for."""
+    _force_cpu_if_requested()
+    from fedml_tpu.algorithms.fedavg_mux import FedAvgMuxClientManager
+
+    ds, bundle, init, lu = _build_problem(args.seed, args.num_clients,
+                                          args.input_dim, args.train_samples)
+    node_ids = list(range(args.node_id,
+                          args.node_id + max(1, args.virtual_clients)))
+    plan = _chaos_plan()
+    reconnect = args.auto_reconnect if args.auto_reconnect >= 0 else 3
+    mux = _connect_mux_backend(node_ids, args.host, args.port,
+                               auto_reconnect=reconnect, wire=args.wire)
+    # chaos parity: the plan wraps each VIRTUAL node's backend, so
+    # fault decisions are keyed by virtual node id — the exact per-node
+    # streams the one-process-per-client topology would draw
+    wrap = None
+    if plan is not None and "client" in plan.roles:
+        from fedml_tpu.faults import ChaosBackend
+
+        wrap = lambda vb: ChaosBackend(vb, plan)  # noqa: E731
+    # crash schedule: the flag wins; otherwise ANY virtual id with a
+    # plan-scheduled crash takes the whole muxer down at the EARLIEST
+    # such round — a process crash is process-granular, so one virtual
+    # client's schedule costs its co-located peers too (the honest
+    # muxer blast radius; chaos_run's muxer_crash scenario)
+    crash_rounds = [
+        r for r in (_resolve_crash_round(args.crash_at_round, plan, n)
+                    for n in node_ids)
+        if r is not None
+    ]
+    mgr = FedAvgMuxClientManager(
+        mux, lu, ds, batch_size=args.batch_size,
+        template_variables=init, seed=args.seed,
+        train_delay=args.train_delay,
+        crash_at_round=min(crash_rounds) if crash_rounds else None,
+        wrap_backend=wrap,
+    )
+    mlog = _node_metrics_logger(args.run_dir, f"mux{args.node_id}")
+    if mlog is not None:
+        # timeline grouping evidence: fed_timeline parks every virtual
+        # client's track under this muxer's process
+        from fedml_tpu.obs.telemetry import get_telemetry
+
+        get_telemetry().event("mux_members", muxer=args.node_id,
+                              nodes=node_ids)
+    stop_flusher = _start_event_flusher(mlog)
+    mgr.run()  # returns on FINISH
+    stop_flusher()
+    if mlog is not None:
+        mlog.log_telemetry()
+        mlog.close()
+    # the same per-client reproducibility probes the single-process
+    # role prints — one line per virtual client, so digest comparisons
+    # are topology-blind
+    digests = mgr.upload_digests
+    for n in node_ids:
+        print(json.dumps({
+            f"client_{n}_upload_digest": digests[n],
+            f"client_{n}_rounds_trained": mgr.rounds_trained[n],
+        }), flush=True)
+
+
 def launch(
     num_clients: int = 3,
     rounds: int = 2,
@@ -395,6 +484,9 @@ def launch(
     clients_per_round: int = 0,
     spares: int = 0,
     auto_reconnect: int = 0,
+    muxers: int = 0,
+    muxed_clients: int = 0,
+    crash_muxer_at_round: int = -1,
     chaos_plan: str = "",
     codec: str = "none",
     wire: int = 2,
@@ -441,6 +533,16 @@ def launch(
       ``FEDML_TPU_CHAOS`` env var (message-level drop/corrupt/...);
     - ``info``: optional dict the launcher fills with the server's
       final stdout JSON (fault counters) and the hub's shutdown stats.
+
+    Virtual-client multiplexing: with ``muxers=M`` the first
+    ``muxed_clients`` client ids (default: ALL of them) are driven by M
+    muxer processes instead of one process each — client count and
+    process count decouple, which is how a 10,000-client federation
+    fits on one box.  Remaining ids still get dedicated client
+    processes (a MIXED cohort: muxed + per-process + old hello-v1
+    dialers on one hub).  ``crash_muxer_at_round`` hard-exits the FIRST
+    muxer when that round's sync arrives — hundreds of virtual clients
+    vanish at once (the ``muxer_crash`` chaos scenario).
     """
     env = dict(env or os.environ)
     if server_env is not None:
@@ -504,6 +606,30 @@ def launch(
             common += ["--spares", str(spares)]
         if auto_reconnect:
             common += ["--auto-reconnect", str(auto_reconnect)]
+        muxed = 0
+        mux_procs = []
+        if muxers:
+            muxed = min(muxed_clients or num_clients, num_clients)
+            base_sz, rem = divmod(muxed, muxers)
+            start = 1
+            for j in range(muxers):
+                size = base_sz + (1 if j < rem else 0)
+                if size <= 0:
+                    continue
+                mux_procs.append(subprocess.Popen(
+                    me + ["--role", "muxer", "--node-id", str(start),
+                          "--virtual-clients", str(size)] + common
+                    + (["--crash-at-round", str(crash_muxer_at_round)]
+                       if crash_muxer_at_round >= 0 and j == 0 else []),
+                    env=env,
+                    # muxer stdout carries one upload-digest JSON line
+                    # PER virtual client — digest comparisons against a
+                    # per-process run are topology-blind
+                    stdout=subprocess.PIPE if info is not None else None,
+                    text=True if info is not None else None,
+                ))
+                start += size
+        procs += mux_procs
         clients = [
             subprocess.Popen(
                 me + ["--role", "client", "--node-id", str(i + 1)] + common
@@ -518,7 +644,7 @@ def launch(
                 stdout=subprocess.PIPE if info is not None else None,
                 text=True if info is not None else None,
             )
-            for i in range(num_clients)
+            for i in range(muxed, num_clients)
         ]
         procs += clients
         idle = [
@@ -564,7 +690,7 @@ def launch(
             hubs.append(hub)
             if not hub.stdout.readline():
                 raise RuntimeError("restarted hub died before binding")
-        if kill_slow_client_after and slow_client_delay:
+        if kill_slow_client_after and slow_client_delay and clients:
             # wait until EVERYONE (clients + server) is registered — the
             # server's await_peers barrier has then passed, so killing
             # the slow client can no longer wedge startup; by now it is
@@ -595,16 +721,30 @@ def launch(
         rc = server.wait(timeout=timeout)
         if info is not None:
             _collect_json_lines(server.stdout, info)
-        for c in clients:
+        for c in clients + mux_procs:
+            out = None
             try:
-                c.wait(timeout=30)
+                if c.stdout is not None:
+                    # communicate DRAINS stdout while waiting: a muxer
+                    # prints one digest line per virtual client, which
+                    # overruns the 64 KB pipe at a few hundred virtual
+                    # clients — a bare wait() would deadlock against
+                    # the child's blocked write and then kill it
+                    out, _ = c.communicate(timeout=30)
+                else:
+                    c.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 # a wedged client must not fail the launcher: under
                 # chaos a client whose FINISH was lost blocks forever —
                 # reap it (the server outcome is what the caller asserts)
                 c.kill()
-            if info is not None:
-                _collect_json_lines(c.stdout, info)
+                if c.stdout is not None:
+                    try:
+                        out, _ = c.communicate(timeout=5)
+                    except Exception:
+                        out = None
+            if info is not None and out:
+                _collect_json_lines(out, info)
         if extra_idle_clients:
             assert killed_registered_peer
         return rc
@@ -624,10 +764,16 @@ def launch(
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--role", choices=["hub", "server", "client"], required=True)
+    p.add_argument("--role", choices=["hub", "server", "client", "muxer"],
+                   required=True)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, required=True)
     p.add_argument("--node-id", type=int, default=0)
+    # muxer role: ONE process drives this many virtual clients (node
+    # ids --node-id .. --node-id + N - 1) over ONE hub connection,
+    # training each round's cohort in one vmapped jit step — client
+    # count and process count decouple (ROADMAP item 2)
+    p.add_argument("--virtual-clients", type=int, default=1)
     p.add_argument("--num-clients", type=int, default=3)
     p.add_argument("--clients-per-round", type=int, default=0)
     p.add_argument("--rounds", type=int, default=2)
@@ -692,6 +838,8 @@ def main(argv=None):
                 stripe_pace=args.stripe_pace)
     elif args.role == "server":
         run_server(args)
+    elif args.role == "muxer":
+        run_muxer(args)
     else:
         run_client(args)
 

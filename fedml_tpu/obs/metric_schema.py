@@ -37,8 +37,11 @@ COUNTERS = {
     "comm.stripe_frames": "mcast_stripe continuation frames received {msg_type=}",
     "comm.stripe_reassemblies": "striped logical frames reassembled + delivered {msg_type=}",
     "comm.stripe_aborts": "striped logical frames killed (gap/crc/overflow/stale/undecodable) {reason=,msg_type=}",
+    "comm.mux_frames": "muxed broadcast copies received on a shared connection {msg_type=}",
+    "comm.mux_deliveries": "local fan-out deliveries to co-located virtual nodes {msg_type=}",
     "hub.mcast_frames": "mcast control frames fanned out by the hub {msg_type=}",
     "hub.dropped_frames": "frames to unregistered/dead/over-bound receivers {msg_type=}",
+    "hub.node_rebinds": "node ids re-claimed by a newer connection (new conn wins)",
     "faults.injected": "chaos-layer injections {action=,msg_type=}",
     "faults.observed": "tolerance-layer observations {kind=,msg_type=}",
     "rounds.degraded": "rounds closed under the aggregation target",
@@ -48,9 +51,12 @@ COUNTERS = {
 
 # --- gauges (instantaneous, or cumulative with _total; gauge_set/max) --------
 GAUGES = {
-    "hub.connections": "currently registered hub connections",
-    "hub.send_queue_frames": "per-connection outbound queue depth {node=}",
-    "hub.send_queue_bytes": "per-connection outbound queue bytes {node=}",
+    "hub.connections": "physical hub connections (== nodes for v1 dialers)",
+    "hub.nodes": "registered node ids (>= connections under muxing)",
+    "hub.send_queue_frames": "per-connection outbound queue depth {conn=}",
+    "hub.send_queue_bytes": "per-connection outbound queue bytes {conn=}",
+    "hub.conn_nodes": "node ids registered on a connection {conn=}",
+    "hub.node_rebinds_total": "cumulative id rebinds (time series form)",
     "hub.backpressure_drops_total": "cumulative over-bound queue drops",
     "hub.mcast_frames_total": "cumulative mcast frames (time series form)",
     "hub.stripe_frames_total": "cumulative enqueued mcast stripes (time series form)",
@@ -96,6 +102,7 @@ EVENTS = {
     "hub_stats": "hub queue-depth/backpressure snapshot (1 s timer)",
     "clock_sync": "dial-handshake offset estimate {node, offset_s, rtt_s}",
     "trace_hop": "full per-message hop chain (receiver-side emission)",
+    "mux_members": "muxer membership {muxer, nodes} — timeline track grouping",
 }
 
 # flat view used by the linter and by tools that just need existence

@@ -495,14 +495,21 @@ def test_cross_device_codec_rerun_bit_identical_digests():
 
 def test_cross_device_legacy_client_with_codec_free_server():
     """No codec key on the sync (server codec='none') => clients upload
-    full-precision models exactly as before the subsystem existed."""
+    full-precision models exactly as before the subsystem existed.
+    Since the muxer (PR 10) the reproducibility digest covers these
+    fp32 wiretrees too — deterministic and distinct per client — so a
+    muxed-vs-per-process comparison pins the uncompressed path as well,
+    not just the codec one."""
+    import hashlib
+
     ds, bundle = _problem()
     server, clients = _run_inproc_federation(ds, bundle, "none")
     assert server.round_idx == 3
-    # digest never updated: the fp32 path bypasses the encoder
-    import hashlib
-
-    assert clients[0].upload_digest == hashlib.sha256().hexdigest()
+    da = [c.upload_digest for c in clients]
+    assert all(d != hashlib.sha256().hexdigest() for d in da)
+    assert len(set(da)) == len(da)  # distinct per client
+    _, clients_b = _run_inproc_federation(ds, bundle, "none")
+    assert da == [c.upload_digest for c in clients_b]  # same-seed rerun
 
 
 def test_corrupted_compressed_upload_rejected():

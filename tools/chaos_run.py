@@ -25,6 +25,14 @@ round with a finite global model.  Default matrix:
                          one (crc) — each must cost exactly one node's
                          sync (deadline straggler), never a wedged
                          reassembly
+    muxer_crash          half the cohort rides ONE muxer process
+                         (virtual-client multiplexing) that os._exit()s
+                         at round 1 — hundreds of clients (here: half
+                         the federation) vanish in one SIGKILL-shaped
+                         event; the spares/stale firewall and the den>0
+                         empty-round guard must keep the survivors
+                         NaN-free and the degradation visible
+                         (rounds.degraded)
 
 Per scenario the output records: survived, rounds completed, rounds
 aggregated empty (``zero_participant_rounds``), degraded rounds,
@@ -122,6 +130,18 @@ def _scenarios(round_timeout: float):
             # small stripes so even the tiny test model stripes
             "stripe_kib": 1,
         },
+        # killing one muxer drops its WHOLE virtual cohort at once (in
+        # production: hundreds of clients; here: half the federation —
+        # clients 1..ceil(N/2) ride the one muxer, the rest run as
+        # plain processes so the survivors keep reporting).  The rounds
+        # after the crash must close degraded by deadline with finite
+        # aggregates, never NaN or a wedge.
+        "muxer_crash": {
+            "muxers": 1,
+            "muxed_clients": -1,  # resolved to ceil(N/2) in run_scenario
+            "crash_muxer_at_round": 1,
+            "round_timeout": round_timeout,
+        },
     }
 
 
@@ -164,6 +184,8 @@ def run_scenario(name: str, kwargs: dict, *, num_clients: int, rounds: int,
     out_path = os.path.join(
         tempfile.mkdtemp(prefix=f"chaos_{name}_"), "final.npz"
     )
+    if kwargs.get("muxed_clients") == -1:
+        kwargs = dict(kwargs, muxed_clients=(num_clients + 1) // 2)
     info: dict = {}
     t0 = time.time()
     print(f"== scenario {name} ==", flush=True)

@@ -91,10 +91,14 @@ def load_run(run_dir: str) -> dict:
     hops: List[dict] = []
     rounds: List[dict] = []
     hub_stats: List[dict] = []
+    mux_of: Dict[int, int] = {}  # virtual node -> its muxer's id
     for path in files:
         for rec in _read_jsonl(path):
             kind = rec.get("kind")
-            if kind == "clock_sync":
+            if kind == "mux_members":
+                for n in rec.get("nodes") or ():
+                    mux_of[int(n)] = int(rec.get("muxer", n))
+            elif kind == "clock_sync":
                 node, off = rec["node"], float(rec["offset_s"])
                 # a second handshake for the same node means the hub
                 # process (the clock every offset is relative to) was
@@ -119,9 +123,15 @@ def load_run(run_dir: str) -> dict:
               "offset (last sync wins).  Per-round spans crossing the "
               "restart are unreliable — trust only rounds entirely on "
               "one side of it.", file=sys.stderr)
+    # virtual clients stamp on their MUXER's process clock (one
+    # handshake per connection, recorded under the muxer's primary id):
+    # propagate that offset to every co-located virtual id
+    for n, m in mux_of.items():
+        if n not in offsets and m in offsets:
+            offsets[n] = offsets[m]
     rounds.sort(key=lambda r: r.get("round", -1))
     return {"offsets": offsets, "hops": hops, "rounds": rounds,
-            "hub_stats": hub_stats, "files": files,
+            "hub_stats": hub_stats, "files": files, "mux": mux_of,
             "clock_resync_nodes": sorted(resynced)}
 
 
@@ -312,16 +322,37 @@ def _pid(node) -> int:
 
 def to_perfetto(bundle: dict, rows: List[dict]) -> dict:
     """Chrome trace-event JSON: one process track per participant,
-    slices for every measured span (hub-clock microseconds)."""
+    slices for every measured span (hub-clock microseconds).  Virtual
+    clients are grouped UNDER their muxer's process track — one pid per
+    muxer, one tid per virtual node (``mux_members`` events) — so the
+    critical-path chain stays readable at hundreds of co-located
+    clients instead of exploding into hundreds of top-level tracks."""
     offsets = bundle["offsets"]
+    mux_of = bundle.get("mux") or {}
     events: List[dict] = []
     names = {0: "hub", 1: "server (node 0)"}
+    threads: Dict[tuple, str] = {}
+
+    def track(node):
+        """(pid, tid) for one participant's slices."""
+        m = mux_of.get(node)
+        if m is not None:
+            pid = _pid(m)
+            if pid not in names:
+                count = sum(1 for v in mux_of.values() if v == m)
+                names[pid] = f"muxer node {m} ({count} virtual clients)"
+            threads[(pid, int(node))] = f"virtual client {node}"
+            return pid, int(node)
+        pid = _pid(node)
+        if pid not in names:
+            names[pid] = f"client node {node}"
+        return pid, 0
+
     all_t: List[float] = []
     for rec in bundle["hops"]:
         for node, _, t in rec.get("hops", ()):
             all_t.append(_hub_t(offsets, node, float(t)))
-            if _pid(node) not in names:
-                names[_pid(node)] = f"client node {node}"
+            track(node)
     for rc in bundle["rounds"]:
         if rc.get("t_open_m") is not None:
             all_t.append(_hub_t(offsets, 0, rc["t_open_m"]))
@@ -335,11 +366,14 @@ def to_perfetto(bundle: dict, rows: List[dict]) -> dict:
     for pid, name in sorted(names.items()):
         events.append({"ph": "M", "pid": pid, "name": "process_name",
                        "args": {"name": name}})
+    for (pid, tid), tname in sorted(threads.items()):
+        events.append({"ph": "M", "pid": pid, "tid": tid,
+                       "name": "thread_name", "args": {"name": tname}})
 
-    def slice_(pid, name, t0, t1, **args):
+    def slice_(pid, name, t0, t1, tid=0, **args):
         if t0 is None or t1 is None or t1 < t0:
             return
-        events.append({"ph": "X", "pid": pid, "tid": 0, "name": name,
+        events.append({"ph": "X", "pid": pid, "tid": tid, "name": name,
                        "ts": us(t0), "dur": round((t1 - t0) * 1e6, 1),
                        "args": args})
 
@@ -350,14 +384,17 @@ def to_perfetto(bundle: dict, rows: List[dict]) -> dict:
         org, node = rec.get("org"), rec.get("node")
         t0 = rec.get("t0")
         if t0 is not None and "send" in h:
-            slice_(_pid(org), f"serialize {tag}",
-                   _hub_t(offsets, org, float(t0)), h["send"], to=node)
+            opid, otid = track(org)
+            slice_(opid, f"serialize {tag}",
+                   _hub_t(offsets, org, float(t0)), h["send"], tid=otid,
+                   to=node)
         slice_(0, f"hub queue {tag} -> {node}",
                h.get("hub_in"), h.get("hub_out"), receiver=node)
-        slice_(_pid(node), f"reassemble {tag}", h.get("reasm"),
-               h.get("recv"), sender=org)
-        slice_(_pid(node), f"handle {tag}", h.get("recv"), h.get("done"),
-               sender=org)
+        npid, ntid = track(node)
+        slice_(npid, f"reassemble {tag}", h.get("reasm"),
+               h.get("recv"), tid=ntid, sender=org)
+        slice_(npid, f"handle {tag}", h.get("recv"), h.get("done"),
+               tid=ntid, sender=org)
     for rc in bundle["rounds"]:
         if rc.get("t_open_m") is None:
             continue
@@ -369,9 +406,11 @@ def to_perfetto(bundle: dict, rows: List[dict]) -> dict:
         t = hs.get("t_m")
         if t is None:
             continue
-        for nid, frames in (hs.get("queue_frames") or {}).items():
+        for cid, frames in (hs.get("queue_frames") or {}).items():
+            # keyed by CONNECTION id since the hello-v2 telemetry split
+            # (a muxer's virtual nodes share one queue)
             events.append({"ph": "C", "pid": 0,
-                           "name": f"send queue frames node {nid}",
+                           "name": f"send queue frames conn {cid}",
                            "ts": us(float(t)),
                            "args": {"frames": frames}})
         events.append({"ph": "C", "pid": 0, "name": "backpressure drops",

@@ -18,6 +18,7 @@ where "messages" are XLA collectives.
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import Dict, Optional
 
@@ -34,6 +35,7 @@ from fedml_tpu.comm.message import (
     MSG_ARG_KEY_NUM_SAMPLES,
     MSG_ARG_KEY_ROUND_INDEX,
     MSG_TYPE_C2S_SEND_MODEL,
+    MSG_TYPE_C2S_TELEMETRY,
     MSG_TYPE_S2C_FINISH,
     MSG_TYPE_S2C_INIT_CONFIG,
     MSG_TYPE_S2C_SYNC_MODEL,
@@ -182,6 +184,7 @@ class FedAvgServerManager(NodeManager):
         "_last_decode_wait_s": "_round_lock",
         "_last_decode_s": "_round_lock",
         "_bcast_task_s": "_round_lock",
+        "_bytes_mark": "_round_lock",
     }
 
     def __init__(
@@ -200,9 +203,11 @@ class FedAvgServerManager(NodeManager):
         multicast: bool = True,
         streaming_agg: bool = True,
         decode_workers: int = 0,
+        stats_plane: bool = True,
+        slo_spec=None,
+        status_dir: Optional[str] = None,
+        stats_interval: float = 1.0,
     ):
-        import threading
-
         from fedml_tpu.compress import get_codec
 
         # uplink compression negotiation: broadcast messages carry the
@@ -286,16 +291,224 @@ class FedAvgServerManager(NodeManager):
         self._last_decode_wait_s = 0.0
         self._last_decode_s = 0.0
         self._bcast_task_s = 0.0
+        # in-band stats plane (obs/digest + obs/slo): the server is the
+        # rollup point — clients/muxers ship one digest frame per report
+        # interval per CONNECTION, the rollup merges them (associative
+        # digest algebra), and the SLO engine evaluates the declared
+        # objectives at every round close.  ``status_dir`` additionally
+        # turns on the live ``status.json`` snapshot (atomic write each
+        # interval + each close) and the final ``slo_report.json`` — a
+        # killed or wedged run leaves evidence mid-flight.
+        self.stats_plane = bool(stats_plane)
+        self.status_dir = status_dir
+        self.stats_interval = max(0.1, float(stats_interval))
+        self._stats_done = threading.Event()
+        self._status_thread: Optional[threading.Thread] = None
+        self._bytes_mark = 0.0
+        if self.stats_plane:
+            from fedml_tpu.obs.digest import DigestRollup, DigestSource
+            from fedml_tpu.obs.slo import SloEngine, SloSpec
+
+            if isinstance(slo_spec, dict):
+                slo_spec = SloSpec.from_obj(slo_spec)
+            slo_spec = slo_spec or SloSpec()
+            if slo_spec.stale_after_s is None:
+                # unset staleness threshold: scale it from the report
+                # interval (5 missed heartbeats), floored at the module
+                # default — a 30 s interval must not flag every live
+                # stream stale between frames
+                import dataclasses
+
+                from fedml_tpu.obs.digest import DEFAULT_STALE_AFTER_S
+
+                slo_spec = dataclasses.replace(
+                    slo_spec,
+                    stale_after_s=max(DEFAULT_STALE_AFTER_S,
+                                      5.0 * max(0.1, float(stats_interval))),
+                )
+            self.slo = SloEngine(slo_spec)
+            self.rollup = DigestRollup()
+            # the server's own registry is just another digest source —
+            # folded locally (no frame to itself) so the merged rollup
+            # covers the whole federation including rank 0
+            self._local_digests = DigestSource(SERVER)
+            # serializes next()+ingest as ONE step: the status thread
+            # and a round-close fold interleaving between the two calls
+            # would hand the rollup a newer seq first and the older
+            # delta (possibly the closing round's wall sample) would be
+            # skipped as a duplicate.  Leaf lock: nothing is acquired
+            # inside the guarded region except the source's and
+            # rollup's own internal locks.
+            self._local_fold_lock = make_lock(
+                "FedAvgServerManager._local_fold_lock")
+        else:
+            self.slo = self.rollup = self._local_digests = None
         super().__init__(backend)
 
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
             MSG_TYPE_C2S_SEND_MODEL, self._on_model
         )
+        # registered even with the stats plane OFF: a half-configured
+        # federation (clients reporting, server arm disabled) must drop
+        # digest frames quietly, not spam unhandled-frame warnings
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_TELEMETRY, self._on_telemetry
+        )
+
+    # -- stats plane --------------------------------------------------------
+    def _on_telemetry(self, msg: Message) -> None:
+        """One digest frame off the wire → the rollup.  ``ingest``
+        validates + counts and never raises, so a corrupted digest can
+        cost at most its own frame — never a reader thread, never a
+        round."""
+        if self.rollup is None:
+            return
+        from fedml_tpu.obs.digest import DIGEST_KEY
+
+        self.rollup.ingest(msg.get(DIGEST_KEY))
+
+    def _expected_nodes(self):
+        return list(range(1, self.num_clients + 1))
+
+    def _comm_bytes_total(self) -> float:
+        snap = get_telemetry().snapshot()["counters"]
+        return sum(v for k, v in snap.items()
+                   if k.startswith(("comm.sent_bytes", "comm.recv_bytes")))
+
+    def _fold_local_digest(self) -> None:
+        if self.rollup is not None:
+            with self._local_fold_lock:
+                self.rollup.ingest(self._local_digests.next())
+
+    def _write_status(self, finished: bool = False) -> None:
+        if self.rollup is None or not self.status_dir:
+            return
+        import os
+
+        from fedml_tpu.obs import slo as slolib
+
+        try:
+            os.makedirs(self.status_dir, exist_ok=True)
+            status = slolib.build_status(
+                self.slo, self.rollup, round_idx=self.round_idx,
+                rounds_total=self.comm_rounds,
+                expected_nodes=self._expected_nodes(), finished=finished,
+            )
+            slolib.write_json_atomic(
+                os.path.join(self.status_dir, "status.json"), status
+            )
+        except OSError:
+            logging.exception("status.json write failed")
+
+    def _status_loop(self) -> None:
+        while not self._stats_done.wait(self.stats_interval):
+            try:
+                self._fold_local_digest()
+                self._write_status()
+            except Exception:
+                # the health plane is best-effort: a snapshot bug must
+                # not silently kill the only thread writing status.json
+                logging.exception("status snapshot failed")
+
+    def _slo_close(self, t_close: float) -> None:  # fedlint: holds=_round_lock
+        """Round-close half of the stats plane (caller holds the round
+        lock): feed the SLO histograms, fold the local registry delta
+        into the rollup so the evaluation sees CURRENT numbers, then
+        evaluate every declared objective."""
+        wall = max(0.0, t_close - self._round_open_t)
+        total_bytes = self._comm_bytes_total()
+        round_bytes = max(0.0, total_bytes - self._bytes_mark)
+        self._bytes_mark = total_bytes
+        self.slo.observe_round(
+            self.round_idx, wall_s=wall, round_bytes=round_bytes,
+            participants=len(self.pending), target=self.clients_per_round,
+        )
+        self._fold_local_digest()
+        self.slo.evaluate(
+            self.round_idx, self.rollup.snapshot(),
+            self.rollup.sources(stale_after=self.slo.spec.stale_after_s),
+            expected_nodes=self._expected_nodes(),
+        )
+        self._write_status()
+
+    def _stats_finish(self) -> None:
+        """Final fold + ``slo_report.json`` + terminal ``status.json``
+        (idempotent — finish can be reached from the last close AND the
+        fail-fast broadcast path)."""
+        if self.rollup is None or self._stats_done.is_set():
+            return
+        self._stats_done.set()
+        if self._status_thread is not None:
+            self._status_thread.join(timeout=5)
+        self._fold_local_digest()
+        if self.status_dir:
+            import os
+
+            from fedml_tpu.obs import slo as slolib
+
+            try:
+                report = self.slo.report(
+                    self.rollup.snapshot(),
+                    self.rollup.sources(
+                        stale_after=self.slo.spec.stale_after_s),
+                    expected_nodes=self._expected_nodes(),
+                    extra={"rounds_completed": self.round_idx,
+                           "clients": self.num_clients,
+                           "clients_per_round": self.clients_per_round},
+                )
+                slolib.write_json_atomic(
+                    os.path.join(self.status_dir, "slo_report.json"), report
+                )
+            except Exception:
+                # finish() must complete whatever the health plane's
+                # state — a report bug losing the FINISH broadcast
+                # would be worse than a missing report
+                logging.exception("slo_report.json write failed")
+            self._write_status(finished=True)
+
+    def stats_summary(self) -> dict:
+        """Machine-readable stats-plane outcome for the entry point's
+        stdout JSON (what campaigns assert streams == connections on)."""
+        if self.rollup is None:
+            return {"enabled": False}
+        snap = self.rollup.stats()
+        sources = self.rollup.sources(
+            stale_after=self.slo.spec.stale_after_s)
+        _, missing = self.slo.coverage(
+            self.rollup.snapshot(), sources, self._expected_nodes())
+        violations_total, _ = self.slo.violation_state()
+        return {
+            "enabled": True,
+            "streams": snap["streams"],
+            # hub-ingested streams: every source EXCEPT the server's own
+            # locally-folded registry — under muxing this equals the
+            # number of client-side CONNECTIONS, never the client count
+            # (the stats plane's O(connections) cost-model assertion)
+            "streams_remote": snap["streams"]
+            - (1 if str(SERVER) in sources else 0),
+            "frames": snap["frames"],
+            "rejected": snap["rejected"],
+            "duplicates": snap["duplicates"],
+            "stale_streams": sorted(
+                s for s, st in sources.items() if st.get("stale")),
+            "missing_nodes_total": len(missing),
+            "slo_violations": violations_total,
+            "slo_ok": violations_total == 0,
+        }
 
     # -- protocol --
     def start(self):
         self._round_open_t = time.perf_counter()
+        if self.rollup is not None:
+            with self._round_lock:
+                self._bytes_mark = self._comm_bytes_total()
+            if self.status_dir and self._status_thread is None:
+                self._status_thread = threading.Thread(
+                    target=self._status_loop, daemon=True,
+                    name="fed-status",
+                )
+                self._status_thread.start()
         self._broadcast_model(MSG_TYPE_S2C_INIT_CONFIG)
         self._arm_deadline()
 
@@ -341,8 +554,6 @@ class FedAvgServerManager(NodeManager):
     def _arm_deadline(self):
         if self.round_timeout is None:
             return
-        import threading
-
         t = threading.Timer(
             self.round_timeout, self._on_deadline, args=(self.round_idx,)
         )
@@ -418,6 +629,10 @@ class FedAvgServerManager(NodeManager):
                 {"round": self.round_idx, "stale_from": msg.sender,
                  "stale_round": reply_round}
             )
+            # counted (not just logged) so the SLO engine's stale-upload
+            # budget reads one series instead of parsing the round_log
+            get_telemetry().inc("faults.observed", kind="stale_upload",
+                                msg_type=MSG_TYPE_C2S_SEND_MODEL)
             return True
         return False
 
@@ -672,6 +887,14 @@ class FedAvgServerManager(NodeManager):
                   decode_wait_s=rec["decode_wait_s"],
                   decode_s=rec["decode_s"],
                   encode_overlap_s=rec["encode_overlap_s"])
+        if self.slo is not None:
+            # stats plane: SLO histograms + evaluation against the
+            # merged rollup, while this round's state is still in hand
+            try:
+                self._slo_close(t_close_m)
+            except Exception:
+                # the health plane must never be able to fail a round
+                logging.exception("SLO close-evaluation failed")
         self.round_log.append(rec)
         self.pending.clear()
         self._agg_acc, self._agg_n = None, 0.0
@@ -746,6 +969,10 @@ class FedAvgServerManager(NodeManager):
                 self._arm_deadline()
 
     def finish(self) -> None:
+        # stats plane last words: final local fold, slo_report.json +
+        # terminal status.json (idempotent — the fail-fast broadcast
+        # path reaches here too)
+        self._stats_finish()
         # non-blocking shutdown: the final _close_round runs ON a
         # decode worker when pipelining, and a wait=True here would
         # join the thread into itself.  Workers drain naturally; any
@@ -819,6 +1046,10 @@ class FedAvgClientManager(NodeManager):
         # when the sync for THIS round arrives — the chaos layer's
         # SIGKILL-at-round-r, reproducible across runs
         self.crash_at_round = crash_at_round
+        # in-band stats plane: the entry point attaches a DigestReporter
+        # here so FINISH stops it with one final delta flush (the
+        # rollup then covers this client's whole run)
+        self.stats_reporter = None
         super().__init__(backend)
 
     def register_message_receive_handlers(self):
@@ -911,4 +1142,8 @@ class FedAvgClientManager(NodeManager):
         return self._upload_hash.hexdigest()
 
     def _on_finish(self, msg: Message):
+        if self.stats_reporter is not None:
+            # final flush BEFORE the backend stops: the last digest
+            # frame rides the still-open connection
+            self.stats_reporter.stop()
         self.finish()

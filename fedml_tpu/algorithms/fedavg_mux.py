@@ -184,6 +184,14 @@ class FedAvgMuxClientManager:
         self._ef: Dict[int, object] = {}
         self._hash = {n: hashlib.sha256() for n in mux.node_ids}
         self.rounds_trained = {n: 0 for n in mux.node_ids}
+        # in-band stats plane: ONE reporter per muxer process IS the
+        # pre-merge — every virtual client shares this process registry,
+        # so one digest frame per interval covers the whole co-located
+        # cohort and the hub ingests one stream per CONNECTION (10k
+        # virtual clients on 4 muxers = 4 digest streams, not 10k).
+        # The entry point attaches it; FINISH stops it with a final
+        # flush before the shared connection closes.
+        self.stats_reporter = None
         self._endpoints: Dict[int, _VirtualEndpoint] = {}
         for n in mux.node_ids:
             vb = mux.virtual(n)
@@ -203,7 +211,16 @@ class FedAvgMuxClientManager:
     def _on_finish(self, node: int, msg: Message) -> None:
         if not self._finished.is_set():
             self._finished.set()
+            if self.stats_reporter is not None:
+                self.stats_reporter.stop()  # final flush, conn still open
             self.mux.stop()
+
+    def reporter_backend(self):
+        """The backend a DigestReporter should send through: the PRIMARY
+        virtual node's (possibly chaos-wrapped) endpoint, so a fault
+        plan targeting that node's telemetry frames applies exactly as
+        it would on a dedicated process."""
+        return self._endpoints[self.mux.node_ids[0]].backend
 
     # -- cohort training ----------------------------------------------------
     def _flush(self) -> None:

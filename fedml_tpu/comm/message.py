@@ -72,6 +72,13 @@ MSG_TYPE_S2C_SYNC_MODEL = "S2C_SYNC_MODEL"
 MSG_TYPE_C2S_SEND_MODEL = "C2S_SEND_MODEL"
 MSG_TYPE_C2S_SEND_STATS = "C2S_SEND_STATS"
 MSG_TYPE_S2C_FINISH = "S2C_FINISH"
+# in-band stats plane (fedml_tpu/obs/digest.py): one mergeable
+# telemetry-digest frame per report interval per CONNECTION — the
+# payload rides the reserved ``__digest__`` key (DIGEST_KEY, defined
+# there), and the frame is deliberately outside faults.DEFAULT_FAULTABLE
+# (observability loss must be injected explicitly, never as a side
+# effect of a model-frame fault mix)
+MSG_TYPE_C2S_TELEMETRY = "C2S_TELEMETRY"
 # split-learning extras (reference split_nn/message_define.py:6-16)
 MSG_TYPE_C2S_SEND_ACTS = "C2S_SEND_ACTS"
 MSG_TYPE_S2C_SEND_GRADS = "S2C_SEND_GRADS"

@@ -190,6 +190,24 @@ def _start_event_flusher(mlog, interval: float = 1.0):
     return _stop
 
 
+def _start_stats_reporter(args, backend, mgr, nodes):
+    """Attach + start a DigestReporter when the stats plane is on: one
+    delta-digest frame per report interval, through the SAME (possibly
+    chaos-wrapped) backend the manager sends on — so a telemetry_loss
+    fault plan drops digest frames exactly where it would on a
+    dedicated process.  The manager stops it at FINISH with a final
+    flush; the returned handle is the entry point's belt-and-braces
+    stop for runs that end without one (killed hub, crash)."""
+    if args.stats_plane != "on":
+        return None
+    from fedml_tpu.obs.digest import DigestReporter
+
+    reporter = DigestReporter(backend, interval=args.report_interval,
+                              nodes=nodes)
+    mgr.stats_reporter = reporter
+    return reporter.start()
+
+
 def run_hub(host: str, port: int, run_dir: str = "",
             stats_interval: float = 1.0, fanout: str = "striped",
             stripe_kib: int = 256, stripe_pace: int = 8) -> None:
@@ -272,6 +290,13 @@ def run_server(args) -> None:
               "clients: a SYNC lost during a client's reconnect window "
               "deadlocks the round; set --round-timeout to tolerate "
               "connection drops", file=sys.stderr, flush=True)
+    # stats plane: declared SLO objectives (--slo: inline JSON or a
+    # file path); an empty spec still produces the full health report
+    slo_spec = None
+    if args.slo:
+        from fedml_tpu.obs.slo import SloSpec
+
+        slo_spec = SloSpec.from_arg(args.slo)
     server = FedAvgServerManager(
         backend, init, num_clients=args.num_clients,
         clients_per_round=args.clients_per_round or args.num_clients,
@@ -286,6 +311,14 @@ def run_server(args) -> None:
         # hotpath only (the legacy arm is the fully serial baseline)
         decode_workers=(args.decode_workers
                         if args.hotpath == "fast" else 0),
+        # in-band stats plane (obs/digest + obs/slo): rollup of the
+        # cohort's digest frames + per-round SLO evaluation; with a
+        # run_dir the live status.json and final slo_report.json land
+        # there (--stats-plane off = the A/B measurement baseline arm)
+        stats_plane=args.stats_plane == "on",
+        slo_spec=slo_spec,
+        status_dir=args.run_dir or None,
+        stats_interval=args.report_interval,
     )
     # startup barrier: the hub drops frames to unregistered receivers,
     # so broadcasting before every client registered would hang
@@ -329,6 +362,10 @@ def run_server(args) -> None:
         "zero_participant_rounds": server.zero_participant_rounds,
         "rejected_uploads": server.rejected_uploads,
         "rounds_degraded": snap.get("rounds.degraded", 0),
+        # stats-plane outcome: digest streams ingested (== CONNECTIONS
+        # under muxing, not clients), frames/rejects, SLO verdict — the
+        # health campaign asserts on this line
+        "stats_plane": server.stats_summary(),
         "faults": {k: v for k, v in snap.items()
                    if k.startswith(("faults.", "comm.unhandled",
                                     "comm.send_retries", "comm.send_failed",
@@ -386,7 +423,11 @@ def run_client(args) -> None:
     # on long runs
     mlog = _node_metrics_logger(args.run_dir, f"node{args.node_id}")
     stop_flusher = _start_event_flusher(mlog)
+    reporter = _start_stats_reporter(args, backend, mgr,
+                                     nodes=[args.node_id])
     backend.run()  # returns on FINISH
+    if reporter is not None:
+        reporter.stop(final_flush=False)  # idempotent; FINISH flushed
     stop_flusher()
     if mlog is not None:
         mlog.log_telemetry()
@@ -451,7 +492,16 @@ def run_muxer(args) -> None:
         get_telemetry().event("mux_members", muxer=args.node_id,
                               nodes=node_ids)
     stop_flusher = _start_event_flusher(mlog)
+    # the muxer's ONE reporter pre-merges the whole virtual cohort:
+    # its digest covers every co-located node id, so the hub/server
+    # ingests one stream per connection (not per client) — the O(conns)
+    # stats-plane cost model.  It sends through the primary virtual
+    # node's chaos-wrapped endpoint (fault-plan parity).
+    reporter = _start_stats_reporter(args, mgr.reporter_backend(), mgr,
+                                     nodes=node_ids)
     mgr.run()  # returns on FINISH
+    if reporter is not None:
+        reporter.stop(final_flush=False)  # idempotent; FINISH flushed
     stop_flusher()
     if mlog is not None:
         mlog.log_telemetry()
@@ -499,6 +549,9 @@ def launch(
     train_samples: int = 60,
     run_dir: str = "",
     trace: bool = False,
+    stats_plane: str = "on",
+    report_interval: float = 1.0,
+    slo: str = "",
     info=None,
     env=None,
     server_env=None,
@@ -595,6 +648,12 @@ def launch(
             common += ["--decode-workers", str(decode_workers)]
         if train_samples != 60:
             common += ["--train-samples", str(train_samples)]
+        if stats_plane != "on":
+            common += ["--stats-plane", stats_plane]
+        if report_interval != 1.0:
+            common += ["--report-interval", str(report_interval)]
+        if slo:
+            common += ["--slo", slo]
         if round_timeout:
             common += ["--round-timeout", str(round_timeout)]
         if clients_per_round:
@@ -828,6 +887,17 @@ def main(argv=None):
     p.add_argument("--run-dir", default="")
     p.add_argument("--trace", action="store_true")
     p.add_argument("--stats-interval", type=float, default=1.0)
+    # in-band stats plane (fedml_tpu/obs/digest + obs/slo): clients and
+    # muxers ship one mergeable telemetry-digest frame per
+    # --report-interval per CONNECTION; the server merges the rollup,
+    # evaluates --slo objectives per round, and (with --run-dir) writes
+    # the live status.json + final slo_report.json that tools/fed_slo.py
+    # renders.  --stats-plane off is the overhead-measurement baseline.
+    p.add_argument("--stats-plane", choices=["on", "off"], default="on")
+    p.add_argument("--report-interval", type=float, default=1.0)
+    p.add_argument("--slo", default="",
+                   help="SLO spec: inline JSON or a path to a JSON file "
+                        "(obs/slo.SloSpec fields)")
     args = p.parse_args(argv)
     if args.trace:
         # before any comm import reads (and caches) the switch

@@ -42,6 +42,12 @@ COUNTERS = {
     "hub.mcast_frames": "mcast control frames fanned out by the hub {msg_type=}",
     "hub.dropped_frames": "frames to unregistered/dead/over-bound receivers {msg_type=}",
     "hub.node_rebinds": "node ids re-claimed by a newer connection (new conn wins)",
+    "digest.sent": "telemetry digest frames emitted by this process's reporter",
+    "digest.frames": "digest frames accepted + merged by the rollup",
+    "digest.rejected": "digest frames rejected pre-merge {reason=}",
+    "digest.dup_frames": "digest frames skipped: per-source seq did not advance",
+    "slo.violations": "SLO objective violations {objective=}",
+    "slo.evaluations": "SLO evaluation passes (one per closed round)",
     "faults.injected": "chaos-layer injections {action=,msg_type=}",
     "faults.observed": "tolerance-layer observations {kind=,msg_type=}",
     "rounds.degraded": "rounds closed under the aggregation target",
@@ -62,6 +68,7 @@ GAUGES = {
     "hub.stripe_frames_total": "cumulative enqueued mcast stripes (time series form)",
     "jax.device_mem_bytes": "device memory in use {device=}",
     "jax.device_mem_peak_bytes": "high-water device memory {device=}",
+    "digest.streams": "distinct digest source streams the rollup has seen",
     "clock.hub_offset_s": "estimated monotonic-clock offset to the hub {node=}",
     "clock.hub_rtt_s": "min round-trip of the clock-sync burst {node=}",
 }
@@ -78,6 +85,8 @@ HISTOGRAMS = {
     "span.server_round_s": "server round wall time, open to close",
     "span.reconnect_s": "outage span, first EOF to re-registered",
     "span.traced_round_s": "per-round synced seconds under trace_rounds",
+    "slo.round_wall_s": "server round wall (open->close) — the SLO percentile source",
+    "slo.round_bytes": "server-visible comm bytes folded per round (sent+recv delta)",
     "jax.compile_s": "wall time of compile-triggering calls {fn=}",
     "jax.backend_compile_s": "runtime-reported compile durations {event=}",
 }
@@ -103,6 +112,7 @@ EVENTS = {
     "clock_sync": "dial-handshake offset estimate {node, offset_s, rtt_s}",
     "trace_hop": "full per-message hop chain (receiver-side emission)",
     "mux_members": "muxer membership {muxer, nodes} — timeline track grouping",
+    "slo_violation": "one failed SLO objective {round, objective, observed, threshold}",
 }
 
 # flat view used by the linter and by tools that just need existence

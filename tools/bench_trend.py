@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Benchmark trajectory: one trend table over every checked-in artifact.
+
+The repo accumulates measurement artifacts PR after PR (BENCH_*,
+FEDLAT_*, FEDSCALE_*, FEDTRACE_*, FAULTS_*, CONVERGENCE_*, COMPRESS_*,
+MULTICHIP_*, SCALING_*, FEDERATION_*, FEDHEALTH_*) but until this tool
+had zero trajectory tooling — answering "did round-wall p50 regress
+since r07?" meant opening five JSON files by hand.  This parses them
+all into one table keyed by (round, artifact) with each artifact's
+headline numbers, so the trend is a single read — and CI uploads the
+JSON form on every run as a downloadable trajectory artifact.
+
+    python tools/bench_trend.py                  # table over the repo root
+    python tools/bench_trend.py --json           # machine-readable records
+    python tools/bench_trend.py --metric p50     # filter headline keys
+
+Stdlib-only (runs in the CI lint job's bare interpreter).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+PREFIXES = (
+    "BENCH_", "FEDLAT_", "FEDSCALE_", "FEDTRACE_", "FEDHEALTH_",
+    "FAULTS_", "CONVERGENCE_", "COMPRESS_", "MULTICHIP_", "SCALING_",
+    "FEDERATION_",
+)
+
+_ROUND_RE = re.compile(r"[_-]r(\d+)")
+
+
+def _round_of(fname: str):
+    m = _ROUND_RE.search(fname)
+    return int(m.group(1)) if m else None
+
+
+def _num(v):
+    return v if isinstance(v, (int, float)) and not isinstance(v, bool) \
+        else None
+
+
+def _deep_get(doc, path, default=None):
+    cur = doc
+    for key in path.split("."):
+        if not isinstance(cur, dict) or key not in cur:
+            return default
+        cur = cur[key]
+    return cur
+
+
+def _first(doc, *paths):
+    for p in paths:
+        v = _deep_get(doc, p)
+        if v is not None:
+            return v
+    return None
+
+
+def _convergence_metrics(doc: dict) -> dict:
+    out = {}
+    arms = doc.get("arms")
+    if isinstance(arms, dict):
+        for arm, rec in arms.items():
+            if isinstance(rec, dict):
+                acc = _num(rec.get("final_test_acc") or rec.get("final_acc"))
+                if acc is not None:
+                    out[f"acc[{arm}]"] = acc
+    runs = doc.get("runs")
+    if isinstance(runs, dict):
+        for arm, rec in runs.items():
+            if isinstance(rec, dict):
+                acc = _num(rec.get("final_test_acc") or rec.get("final_acc"))
+                if acc is not None:
+                    out[f"acc[{arm}]"] = acc
+    for key in ("final_test_acc", "final_acc"):
+        v = _num(doc.get(key))
+        if v is not None:
+            out["acc"] = v
+    rtt = _deep_get(doc, "verdict.rounds_to_target")
+    if isinstance(rtt, dict):
+        for arm, v in rtt.items():
+            if _num(v) is not None:
+                out[f"rounds_to_target[{arm}]"] = v
+    return out
+
+
+def _extract(doc: dict, fname: str) -> dict:
+    """Headline numbers per artifact family — tolerant by design: an
+    extractor that finds nothing leaves an empty metrics dict rather
+    than failing the whole table (artifact shapes evolve PR to PR)."""
+    out = {}
+    if fname.startswith("BENCH_"):
+        # three generations of bench artifact shape: headline{}, parsed{},
+        # and the bare top-level {metric, value, vs_baseline} form
+        for sec in (doc.get("headline"), doc.get("parsed"), doc):
+            if isinstance(sec, dict) and _num(sec.get("value")) is not None:
+                name = str(sec.get("metric", "value"))
+                out[name] = sec["value"]
+                if _num(sec.get("vs_baseline")) is not None:
+                    out["vs_baseline"] = sec["vs_baseline"]
+                break
+    elif fname.startswith("FEDLAT_"):
+        for arm in ("striped", "whole", "legacy", "fast"):
+            v = _num(_first(doc, f"arms.{arm}.p50_median_of_reps",
+                            f"arms.{arm}.p50_pooled"))
+            if v is not None:
+                out[f"p50[{arm}]"] = v
+        v = _num(_deep_get(doc, "verdict.bcast_queue_p50_s.striped"))
+        if v is not None:
+            out["bcast_queue_p50"] = v
+        p50s = _deep_get(doc, "verdict.p50_round_wall_s")
+        if isinstance(p50s, dict):
+            for arm, v in p50s.items():
+                if _num(v) is not None and len(out) < 6:
+                    out[f"p50[{arm}]"] = v
+    elif fname.startswith("FEDSCALE_"):
+        out["clients"] = _num(_deep_get(doc, "scale.scale_run.clients"))
+        out["scale_p50"] = _num(
+            _deep_get(doc, "scale.scale_run.round_wall_s.p50"))
+        out["hub_rss_ratio"] = _num(_deep_get(doc, "scale.hub_rss_ratio"))
+        for arm in ("mux", "proc_fast", "proc_legacy"):
+            v = _num(_deep_get(doc, f"latency_ab.verdict.{arm}_p50"))
+            if v is not None:
+                out[f"p50[{arm}]"] = v
+    elif fname.startswith("FEDHEALTH_"):
+        for k in ("p50_on", "p50_off", "overhead_ratio", "streams",
+                  "slo_p50", "posthoc_p50"):
+            v = _num(_deep_get(doc, f"verdict.{k}"))
+            if v is not None:
+                out[k] = v
+        ok = _deep_get(doc, "verdict.ok")
+        if ok is not None:
+            out["ok"] = bool(ok)
+    elif fname.startswith("FEDTRACE_"):
+        for arm in ("off_16", "on_16"):
+            v = _num(_first(doc, f"arms.{arm}.p50_median_of_reps",
+                            f"arms.{arm}.round_wall_s.p50"))
+            if v is not None:
+                out[f"p50[{arm}]"] = v
+    elif fname.startswith("FAULTS_"):
+        scenarios = doc.get("scenarios")
+        if isinstance(scenarios, list):
+            out["scenarios"] = len(scenarios)
+            out["survived"] = sum(
+                1 for s in scenarios if s.get("survived"))
+        out["all_nan_free"] = bool(doc.get("all_nan_free"))
+    elif fname.startswith("CONVERGENCE_"):
+        out.update(_convergence_metrics(doc))
+    elif fname.startswith("COMPRESS_"):
+        v = _num(_deep_get(doc, "verdict.reduction_ratio"))
+        if v is not None:
+            out["reduction_ratio"] = v
+    elif fname.startswith("MULTICHIP_"):
+        out["ok"] = bool(doc.get("ok"))
+        if _num(doc.get("n_devices")) is not None:
+            out["n_devices"] = doc["n_devices"]
+    elif fname.startswith("SCALING_"):
+        v = _num(_deep_get(doc, "model.headline.comm_compute_ratio_at_256"))
+        if v is not None:
+            out["comm_compute_ratio_at_256"] = v
+    elif fname.startswith("FEDERATION_"):
+        out["wall_s"] = _num(_deep_get(doc, "clean_run.total_wall_s"))
+        out["oracle_ok"] = bool(_deep_get(doc, "oracle_parity.ok"))
+    return {k: v for k, v in out.items() if v is not None}
+
+
+def collect(root: str):
+    records = []
+    for prefix in PREFIXES:
+        for path in sorted(glob.glob(os.path.join(root, prefix + "*.json"))):
+            fname = os.path.basename(path)
+            try:
+                with open(path) as fh:
+                    doc = json.load(fh)
+            except (OSError, json.JSONDecodeError) as e:
+                records.append({"artifact": fname, "round": _round_of(fname),
+                                "error": f"{type(e).__name__}: {e}",
+                                "metrics": {}})
+                continue
+            if not isinstance(doc, dict):
+                continue
+            records.append({
+                "artifact": fname,
+                "round": _round_of(fname),
+                "kind": prefix.rstrip("_").lower(),
+                "metrics": _extract(doc, fname),
+            })
+    records.sort(key=lambda r: (r["round"] if r["round"] is not None
+                                else -1, r["artifact"]))
+    return records
+
+
+def _fmt_val(v):
+    if isinstance(v, bool):
+        return str(v)
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def render(records, metric_filter: str = "") -> str:
+    lines = ["round  artifact                                  headline",
+             "-" * 100]
+    for r in records:
+        metrics = r.get("metrics") or {}
+        if metric_filter:
+            metrics = {k: v for k, v in metrics.items()
+                       if metric_filter in k}
+            if not metrics:
+                continue
+        headline = "  ".join(f"{k}={_fmt_val(v)}"
+                             for k, v in list(metrics.items())[:6])
+        if "error" in r:
+            headline = f"UNREADABLE ({r['error']})"
+        rnd = r["round"] if r["round"] is not None else "-"
+        lines.append(f"{str(rnd):<6} {r['artifact']:<41} {headline}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="artifact directory (default: the repo root)")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--out", default="",
+                   help="also write the JSON records to this path")
+    p.add_argument("--metric", default="",
+                   help="filter headline keys by substring (table mode)")
+    args = p.parse_args(argv)
+    records = collect(args.dir)
+    if not records:
+        print(f"no benchmark artifacts under {args.dir!r}", file=sys.stderr)
+        return 2
+    doc = {"artifacts": len(records), "records": records}
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(doc, fh, indent=1)
+    if args.json:
+        print(json.dumps(doc, indent=1))
+    else:
+        print(render(records, args.metric))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

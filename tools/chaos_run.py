@@ -101,6 +101,18 @@ def _scenarios(round_timeout: float):
                          msg_type="S2C_SYNC_MODEL", direction="stripe")],
         roles=("client",),
     ).to_json()
+    # stats-plane blackout: node 2 loses EVERY digest frame it emits
+    # (C2S_TELEMETRY is outside DEFAULT_FAULTABLE, so the explicit rule
+    # is the only way observability loss happens — never as a side
+    # effect of a model-frame mix).  Rounds must be untouched and the
+    # rollup un-wedged; the SLO report must flag node 2 as MISSING
+    # coverage (counted + named, never silent).
+    telemetry_plan = FaultPlan(
+        seed=0,
+        rules=[FaultRule(action="drop", node=2,
+                         msg_type="C2S_TELEMETRY", direction="send")],
+        roles=("client",),
+    ).to_json()
     return {
         "fault_free": {},
         "client_crash": {
@@ -141,6 +153,20 @@ def _scenarios(round_timeout: float):
             "muxed_clients": -1,  # resolved to ceil(N/2) in run_scenario
             "crash_muxer_at_round": 1,
             "round_timeout": round_timeout,
+        },
+        # dropped digest frames must never affect rounds or wedge the
+        # rollup: the run completes normally while the SLO report flags
+        # the silenced node (run_dir="auto" -> a tmpdir; run_scenario
+        # reads slo_report.json back as scenario evidence)
+        "telemetry_loss": {
+            "chaos_plan": telemetry_plan,
+            "round_timeout": round_timeout,
+            "run_dir": "auto",
+            # short staleness threshold so the blacked-out node trips
+            # the coverage objective within this few-round run (the
+            # engine's startup grace = one threshold of uptime)
+            "slo": json.dumps({"max_stale_streams": 0,
+                               "stale_after_s": 1.5}),
         },
     }
 
@@ -186,6 +212,8 @@ def run_scenario(name: str, kwargs: dict, *, num_clients: int, rounds: int,
     )
     if kwargs.get("muxed_clients") == -1:
         kwargs = dict(kwargs, muxed_clients=(num_clients + 1) // 2)
+    if kwargs.get("run_dir") == "auto":
+        kwargs = dict(kwargs, run_dir=os.path.dirname(out_path))
     info: dict = {}
     t0 = time.time()
     print(f"== scenario {name} ==", flush=True)
@@ -209,8 +237,27 @@ def run_scenario(name: str, kwargs: dict, *, num_clients: int, rounds: int,
         "rejected_uploads": info.get("rejected_uploads"),
         "server_fault_counters": info.get("faults") or {},
         "hub_stats": info.get("hub_stats") or {},
+        "stats_plane": info.get("stats_plane") or {},
         "wall_s": round(time.time() - t0, 1),
     }
+    report_path = os.path.join(os.path.dirname(out_path), "slo_report.json")
+    if kwargs.get("run_dir") and os.path.exists(report_path):
+        # telemetry-loss evidence: the SLO report must NAME the node(s)
+        # whose digest stream went dark (missing coverage), while the
+        # round outcome above stays untouched
+        try:
+            with open(report_path) as fh:
+                rep = json.load(fh)
+            sp = rep.get("stats_plane") or {}
+            rec["slo_report"] = {
+                "ok": rep.get("ok"),
+                "by_objective": rep.get("by_objective"),
+                "missing_nodes": sp.get("missing_nodes"),
+                "stale_streams": sp.get("stale_streams"),
+                "streams": sp.get("streams"),
+            }
+        except (OSError, json.JSONDecodeError) as e:
+            rec["slo_report"] = {"error": f"{type(e).__name__}: {e}"}
     if os.path.exists(out_path):
         try:
             rec.update(_final_model_eval(out_path, seed, num_clients))

@@ -1,0 +1,221 @@
+#!/usr/bin/env python
+"""FEDHEALTH campaign: the stats plane at scale → ``FEDHEALTH_r11.json``.
+
+A FEDSCALE-style campaign (the 10k-virtual-client topology from
+``tools/fed_scale_run.py``: M muxer processes over M hub connections)
+with the in-band stats plane under test.  Pre-declared bars:
+
+1. the stats-plane-ON arm completes all rounds NaN-free;
+2. hub-ingested telemetry streams == number of CONNECTIONS (muxers),
+   not clients — the O(connections) cost model (10k clients → M
+   digest streams);
+3. ON-arm p50 round wall within 3% of the OFF arm (the PR-6 tracing
+   overhead bar), ABBA-interleaved reps, verdict = median of per-rep
+   p50s;
+4. the written ``slo_report.json``'s p50/p99 round-wall percentiles
+   (log2-bucket upper bounds from the merged histograms) agree with
+   ``tools/fed_timeline.py``'s post-hoc exact numbers within ONE log2
+   bucket.
+
+Usage:
+    python tools/fed_health_run.py --clients 10000 --muxers 4 \
+        --out FEDHEALTH_r11.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.fed_scale_run import _barrier, run_scale_federation  # noqa: E402
+from tools.trace_summary import percentile  # noqa: E402
+
+
+def _log2_bucket(x):
+    """The log2 bucket index a value lands in (the telemetry
+    histogram's bucketing: upper bound 2**ceil(log2(x)))."""
+    if x is None or x <= 0:
+        return None
+    return int(math.ceil(math.log2(x)))
+
+
+def _posthoc_walls(run_dir: str):
+    """Exact per-round walls from the merged per-process metrics files
+    (``fed_timeline``'s round rows — the post-hoc surface the in-band
+    percentiles must agree with)."""
+    from tools.fed_timeline import build_rounds, load_run
+
+    rows = build_rounds(load_run(run_dir))
+    walls = [r["wall_s"] for r in rows if r.get("wall_s") is not None]
+    return {
+        "rounds": len(walls),
+        "p50": percentile(walls, 0.5),
+        "p99": percentile(walls, 0.99),
+        "samples": [round(w, 4) for w in walls],
+    }
+
+
+def one_arm(tag: str, args, stats_on: bool, run_dir: str = "") -> dict:
+    _barrier()
+    print(f"== {tag}: {args.clients} virtual clients on {args.muxers} "
+          f"muxers, stats plane {'ON' if stats_on else 'OFF'} ==",
+          flush=True)
+    flags = ["--stats-plane", "on" if stats_on else "off",
+             "--report-interval", str(args.report_interval)]
+    if stats_on and args.slo:
+        flags += ["--slo", args.slo]
+    info: dict = {}
+    rec = run_scale_federation(
+        args.clients, args.muxers, args.rounds, seed=args.seed,
+        batch_size=args.batch_size, round_timeout=args.round_timeout,
+        timeout=args.timeout, extra_flags=flags, run_dir=run_dir,
+        info=info)
+    rec["tag"] = tag
+    rec["stats_plane"] = info.get("stats_plane") or {}
+    rec["run_dir"] = run_dir
+    print(json.dumps({k: rec[k] for k in
+                      ("tag", "rc", "rounds", "nan_free", "wall_s",
+                       "round_wall_s", "stats_plane")}), flush=True)
+    return rec
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="FEDHEALTH_r11.json")
+    p.add_argument("--clients", type=int, default=10000)
+    p.add_argument("--muxers", type=int, default=4)
+    p.add_argument("--rounds", type=int, default=5)
+    p.add_argument("--reps", type=int, default=2,
+                   help="ABBA-interleaved reps per arm")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--round-timeout", type=float, default=600.0)
+    p.add_argument("--timeout", type=float, default=3600.0)
+    p.add_argument("--report-interval", type=float, default=1.0)
+    p.add_argument("--slo", default=json.dumps(
+        {"p99_round_wall_s": 60.0, "max_corrupt_uploads": 0,
+         "min_participation": 0.5}),
+        help="SLO spec JSON shipped to the server (the campaign's "
+             "declared objectives; generous walls — the bar here is "
+             "agreement + overhead, not a latency gate)")
+    args = p.parse_args(argv)
+
+    on_runs, off_runs = [], []
+    report = None
+    posthoc = None
+    status_seen = False
+    for rep in range(args.reps):
+        # ABBA: adjacent pairs share box state so slow drift cancels
+        order = [True, False] if rep % 2 == 0 else [False, True]
+        for stats_on in order:
+            run_dir = ""
+            if stats_on:
+                run_dir = tempfile.mkdtemp(prefix="fedhealth_")
+            rec = one_arm(
+                f"{'on' if stats_on else 'off'}_r{rep}", args, stats_on,
+                run_dir)
+            (on_runs if stats_on else off_runs).append(rec)
+            if stats_on and run_dir:
+                status_seen = status_seen or os.path.exists(
+                    os.path.join(run_dir, "status.json"))
+                rp = os.path.join(run_dir, "slo_report.json")
+                if os.path.exists(rp):
+                    with open(rp) as fh:
+                        report = json.load(fh)
+                    rec["slo_report_path"] = rp
+                    try:
+                        posthoc = _posthoc_walls(run_dir)
+                    except SystemExit as e:
+                        posthoc = {"error": str(e)}
+                    rec["posthoc"] = posthoc
+
+    def med_p50(runs):
+        return percentile(
+            [r["round_wall_s"]["p50"] for r in runs
+             if r["round_wall_s"]["p50"] is not None], 0.5)
+
+    p50_on, p50_off = med_p50(on_runs), med_p50(off_runs)
+    overhead = (p50_on / p50_off) if (p50_on and p50_off) else None
+    slo_obs = ((report or {}).get("observed") or {}).get(
+        "round_wall_s") or {}
+    slo_p50, slo_p99 = slo_obs.get("p50"), slo_obs.get("p99")
+    ph_p50 = (posthoc or {}).get("p50")
+    ph_p99 = (posthoc or {}).get("p99")
+
+    def bucket_agrees(in_band, exact):
+        if in_band is None or exact is None:
+            return None
+        return abs(_log2_bucket(in_band) - _log2_bucket(exact)) <= 1
+
+    streams = [r["stats_plane"].get("streams_remote")
+               for r in on_runs if r.get("stats_plane")]
+    checks = {
+        "on_arm_complete_nan_free": all(
+            r["rc"] == 0 and r["nan_free"] and r["rounds"] >= args.rounds
+            for r in on_runs),
+        "streams_eq_connections": bool(streams) and all(
+            s == args.muxers for s in streams),
+        # one-sided overhead bar (the PR-6 tracing convention): the ON
+        # arm may not be >3% SLOWER; measuring faster is box noise in
+        # the plane's favor, not a failure
+        "p50_within_3pct": overhead is not None and overhead <= 1.03,
+        "slo_p50_within_one_log2_bucket": bucket_agrees(slo_p50, ph_p50),
+        "slo_p99_within_one_log2_bucket": bucket_agrees(slo_p99, ph_p99),
+        "status_json_written": status_seen,
+        "slo_report_written": report is not None,
+    }
+    artifact = {
+        "experiment": (
+            "in-band stats plane at scale: mergeable digest streams + SLO "
+            "engine on the 10k-virtual-client muxed topology; overhead A/B "
+            "(stats on/off, ABBA reps, median of per-rep p50s) and "
+            "in-band-vs-post-hoc percentile agreement"
+        ),
+        "config": {
+            "clients": args.clients, "muxers": args.muxers,
+            "rounds": args.rounds, "reps": args.reps,
+            "report_interval_s": args.report_interval,
+            "slo_spec": json.loads(args.slo) if args.slo else None,
+            "protocol": "ABBA interleaved, process barrier + settle, "
+                        "verdict = median of per-rep p50s (PR-6/PR-10)",
+        },
+        "generated_unix": round(time.time(), 1),
+        "arms": {"stats_on": on_runs, "stats_off": off_runs},
+        "slo_report_final": report,
+        "posthoc_fed_timeline": posthoc,
+        "thresholds_pre_declared": {
+            "overhead_p50_max": 1.03,
+            "streams": "== muxer connections, not clients",
+            "percentile_agreement": "within one log2 bucket of "
+                                    "fed_timeline's exact post-hoc p50/p99",
+        },
+        "verdict": {
+            "p50_on": p50_on,
+            "p50_off": p50_off,
+            "overhead_ratio": (round(overhead, 4)
+                               if overhead is not None else None),
+            "streams": streams[0] if streams else None,
+            "slo_p50": slo_p50,
+            "slo_p99": slo_p99,
+            "posthoc_p50": ph_p50,
+            "posthoc_p99": ph_p99,
+            "checks": checks,
+            "ok": all(bool(v) for v in checks.values()),
+        },
+    }
+    with open(args.out, "w") as fh:
+        json.dump(artifact, fh, indent=1, default=float)
+    print(json.dumps({"out": args.out, "verdict": artifact["verdict"]},
+                     default=float))
+    return 0 if artifact["verdict"]["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

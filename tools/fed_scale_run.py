@@ -104,12 +104,21 @@ def _barrier(settle: float = 3.0):
 
 def run_scale_federation(clients: int, muxers: int, rounds: int,
                          *, seed: int, batch_size: int,
-                         round_timeout: float, timeout: float) -> dict:
+                         round_timeout: float, timeout: float,
+                         extra_flags=(), run_dir: str = "",
+                         info=None) -> dict:
     """Hub + server + M muxers as OS processes, hub peak RSS recorded.
 
     A local orchestrator rather than ``launch()``: the hub's pid is
     needed mid-run for the VmHWM read, and at 10k clients the per-
-    client stdout plumbing would be pure overhead."""
+    client stdout plumbing would be pure overhead.
+
+    Reuse hooks (``tools/fed_health_run.py`` drives the FEDHEALTH
+    campaign through this function): ``extra_flags`` are appended to
+    every role's command line (e.g. ``--stats-plane off``, ``--slo``),
+    ``run_dir`` turns on per-process metrics files + the server's
+    status/slo artifacts, and ``info`` (a dict) collects the server's
+    final stdout JSON (stats-plane stream counts, fault counters)."""
     me = [sys.executable, "-m", "fedml_tpu.experiments.distributed_fedavg"]
     env = _env()
     out_path = os.path.join(tempfile.mkdtemp(prefix="fedscale_"),
@@ -118,7 +127,9 @@ def run_scale_federation(clients: int, muxers: int, rounds: int,
     hub = None
     t0 = time.time()
     try:
-        hub = subprocess.Popen(me + ["--role", "hub", "--port", "0"],
+        hub_flags = ["--run-dir", run_dir] if run_dir else []
+        hub = subprocess.Popen(me + ["--role", "hub", "--port", "0"]
+                               + hub_flags,
                                stdout=subprocess.PIPE, text=True, env=env)
         port_line = hub.stdout.readline()
         if not port_line:
@@ -128,6 +139,9 @@ def run_scale_federation(clients: int, muxers: int, rounds: int,
                   "--num-clients", str(clients), "--rounds", str(rounds),
                   "--seed", str(seed), "--batch-size", str(batch_size),
                   "--round-timeout", str(round_timeout)]
+        common += list(extra_flags)
+        if run_dir:
+            common += ["--run-dir", run_dir]
         devnull = subprocess.DEVNULL  # 10k digest lines are not evidence here
         if muxers:
             base_sz, rem = divmod(clients, muxers)
@@ -146,9 +160,17 @@ def run_scale_federation(clients: int, muxers: int, rounds: int,
                     + common, env=env, stdout=devnull))
         server = subprocess.Popen(
             me + ["--role", "server", "--out", out_path] + common,
-            env=env)
+            env=env,
+            stdout=subprocess.PIPE if info is not None else None,
+            text=True if info is not None else None)
         procs.append(server)
         rc = server.wait(timeout=timeout)
+        if info is not None and server.stdout is not None:
+            for line in server.stdout.read().splitlines():
+                try:
+                    info.update(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
         # peak RSS is a high-water mark: reading it AFTER the run (hub
         # still alive) captures the whole federation's pressure
         hub_peak_kb = _vm_kb(hub.pid, "VmHWM")
@@ -162,6 +184,7 @@ def run_scale_federation(clients: int, muxers: int, rounds: int,
             "rounds": rounds_done,
             "nan_free": finite,
             "wall_s": wall,
+            "out_path": out_path,
             "hub_peak_rss_mb": round(hub_peak_kb / 1024.0, 1),
             "round_wall_s": {
                 "samples": walls,

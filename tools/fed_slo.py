@@ -1,0 +1,177 @@
+#!/usr/bin/env python
+"""Live federation health view — render ``status.json`` / ``slo_report.json``.
+
+The in-band stats plane (``fedml_tpu/obs/digest`` + ``obs/slo``) makes
+the server write an ATOMIC ``status.json`` snapshot every report
+interval and at every round close, plus a final ``slo_report.json`` —
+so a running (or killed, or wedged) federation always has a current,
+machine-readable picture on disk.  This tool renders it:
+
+    python tools/fed_slo.py RUN_DIR            one-shot human summary
+    python tools/fed_slo.py RUN_DIR --watch    live TUI (re-reads each
+                                               interval; ^C to leave)
+    python tools/fed_slo.py RUN_DIR --json     the raw document(s)
+
+``RUN_DIR`` may also be a direct path to a status.json.  Stdlib-only:
+this must run on a bare interpreter next to a live run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+
+def _resolve(path: str):
+    """(status_path, report_path) from a run_dir or a direct file."""
+    if os.path.isdir(path):
+        return (os.path.join(path, "status.json"),
+                os.path.join(path, "slo_report.json"))
+    if path.endswith("slo_report.json"):
+        return os.path.join(os.path.dirname(path), "status.json"), path
+    return path, os.path.join(os.path.dirname(path), "slo_report.json")
+
+
+def _load(path: str):
+    try:
+        with open(path) as fh:
+            return json.load(fh)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _fmt_s(v) -> str:
+    if v is None:
+        return "-"
+    if v >= 1:
+        return f"{v:.3f}s"
+    return f"{v * 1e3:.1f}ms"
+
+
+def render_status(status: dict, report=None) -> str:
+    """Human block for one status snapshot (the --watch frame body)."""
+    lines = []
+    slo = status.get("slo") or {}
+    state = "FINISHED" if status.get("finished") else "RUNNING"
+    verdict = "OK" if slo.get("ok") else \
+        f"VIOLATED x{slo.get('violations_total', '?')}"
+    lines.append(
+        f"federation {state}  round {status.get('round')}/"
+        f"{status.get('rounds_total')}  SLO {verdict}"
+    )
+    wall = status.get("round_wall_s") or {}
+    lines.append(
+        f"round wall  p50 {_fmt_s(wall.get('p50'))}  "
+        f"p99 {_fmt_s(wall.get('p99'))}  max {_fmt_s(wall.get('max'))}  "
+        f"(n={wall.get('count', 0)}; log2-bucket upper bounds)"
+    )
+    sp = status.get("stats_plane") or {}
+    lines.append(
+        f"stats plane  streams {sp.get('streams', 0)}  "
+        f"frames {sp.get('frames', 0)}  rejected {sp.get('rejected', 0)}  "
+        f"dup {sp.get('duplicates', 0)}  "
+        f"nodes covered {sp.get('nodes_covered', 0)}  "
+        f"missing {sp.get('missing_nodes_total', 0)}"
+    )
+    stale = sp.get("stale_streams") or []
+    if stale:
+        lines.append(f"STALE streams: {', '.join(map(str, stale))}")
+    sources = status.get("sources") or {}
+    if sources:
+        lines.append("per-stream liveness:")
+        lines.append("  src      seq   age     nodes  frames  lost  state")
+        for src in sorted(sources, key=lambda s: int(s) if str(s).lstrip(
+                "-").isdigit() else 1 << 30):
+            st = sources[src]
+            lines.append(
+                f"  {str(src):<8} {st.get('seq', 0):<5} "
+                f"{st.get('age_s', 0):<7} {st.get('nodes', 0):<6} "
+                f"{st.get('frames', 0):<7} {st.get('lost_frames', 0):<5} "
+                f"{'STALE' if st.get('stale') else 'live'}"
+            )
+    recent = slo.get("recent_violations") or []
+    if recent:
+        lines.append("recent violations:")
+        for v in recent:
+            lines.append(
+                f"  round {v.get('round')}: {v.get('objective')} "
+                f"observed={v.get('observed')} threshold={v.get('threshold')}"
+            )
+    counters = (status.get("rollup") or {}).get("counters") or {}
+    interesting = {k: v for k, v in sorted(counters.items())
+                   if k.startswith(("rounds.", "faults.observed",
+                                    "comm.reconnects", "digest.",
+                                    "slo.violations"))}
+    if interesting:
+        lines.append("rollup counters (merged across the federation):")
+        for k, v in list(interesting.items())[:20]:
+            lines.append(f"  {k} = {v:g}")
+    if report is not None:
+        obs = report.get("observed") or {}
+        lines.append(
+            f"final report: ok={report.get('ok')}  "
+            f"violations={report.get('violations_total')}  "
+            f"by_objective={report.get('by_objective')}"
+        )
+        rb = obs.get("round_bytes") or {}
+        lines.append(
+            f"  bytes/round p50 {rb.get('p50')}  "
+            f"participation min {(obs.get('participation') or {}).get('min')}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", help="run_dir (or a status.json path)")
+    p.add_argument("--watch", action="store_true",
+                   help="live mode: redraw every --interval seconds")
+    p.add_argument("--interval", type=float, default=1.0)
+    p.add_argument("--json", action="store_true",
+                   help="emit {status, report} as one JSON object")
+    args = p.parse_args(argv)
+    status_path, report_path = _resolve(args.path)
+
+    if args.json:
+        doc = {"status": _load(status_path), "report": _load(report_path)}
+        if doc["status"] is None and doc["report"] is None:
+            print(f"no status.json / slo_report.json at {args.path!r}",
+                  file=sys.stderr)
+            return 2
+        print(json.dumps(doc, indent=1))
+        return 0
+
+    if not args.watch:
+        status = _load(status_path)
+        if status is None:
+            print(f"no readable status.json at {status_path!r} (run with "
+                  "--run-dir and --stats-plane on)", file=sys.stderr)
+            return 2
+        print(render_status(status, _load(report_path)))
+        return 0
+
+    # --watch: the file is written atomically (tmp + os.replace), so a
+    # re-read mid-write never sees a torn document
+    try:
+        while True:
+            status = _load(status_path)
+            frame = (render_status(status, _load(report_path))
+                     if status is not None
+                     else f"waiting for {status_path} ...")
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            sys.stdout.write(
+                frame + f"\n\n[fed_slo --watch {args.path}; ^C to exit]\n"
+            )
+            sys.stdout.flush()
+            if status is not None and status.get("finished"):
+                return 0
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

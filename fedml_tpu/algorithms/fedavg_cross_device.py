@@ -178,6 +178,8 @@ class FedAvgServerManager(NodeManager):
         "pending": "_round_lock",
         "_agg_acc": "_round_lock",
         "_agg_n": "_round_lock",
+        "_conn_acc": "_round_lock",
+        "_conn_n": "_round_lock",
         "round_log": "_round_lock",
         "rejected_uploads": "_round_lock",
         "zero_participant_rounds": "_round_lock",
@@ -207,6 +209,7 @@ class FedAvgServerManager(NodeManager):
         slo_spec=None,
         status_dir: Optional[str] = None,
         stats_interval: float = 1.0,
+        defense=None,
     ):
         from fedml_tpu.compress import get_codec
 
@@ -247,8 +250,43 @@ class FedAvgServerManager(NodeManager):
         #   close-time aggregation stall collapses to one normalize.
         self.multicast = bool(multicast)
         self.streaming_agg = bool(streaming_agg)
+        # robust aggregation (fedml_tpu/robust): per-upload screening
+        # (norm clip / outlier reject / client-level DP) on the decode
+        # path, per-CONNECTION contribution caps on the streaming fold,
+        # and the buffered median/trimmed-mean close.  ``defense`` is a
+        # DefenseConfig (or its dict form, or None = undefended — the
+        # exact pre-defense code path, byte-identical).
+        from fedml_tpu.robust import DefenseConfig, RobustAggregator
+
+        if isinstance(defense, dict):
+            defense = DefenseConfig(**defense)
+        self.defense = defense if (defense is not None
+                                   and defense.enabled) else None
+        self._robust = (RobustAggregator(self.defense, seed=seed)
+                        if self.defense is not None else None)
+        # buffered estimators need ALL K uploads at close — they buffer
+        # decoded trees like the legacy path even when streaming_agg is
+        # on (memory O(K·model) is inherent to a coordinate-wise
+        # estimator; the streaming defenses exist for the O(1) fold)
+        self._defense_buffered = (self.defense is not None
+                                  and self.defense.buffered)
+        self._conn_cap = (self.defense.conn_cap
+                          if self.defense is not None else 0.0)
+        if self._conn_cap > 0 and not self.streaming_agg:
+            # the cap is enforced by the streaming fold's per-conn
+            # accumulators; on the legacy buffered path it would be
+            # silently inert — refuse, don't run undefended
+            raise ValueError(
+                "conn_cap requires the streaming hot path "
+                "(streaming_agg=True / --hotpath fast)"
+            )
         self._agg_acc = None
         self._agg_n = 0.0
+        # per-connection num/den accumulators (conn caps only):
+        # O(connections · model) — connections, not clients, is the
+        # muxed federation's small axis
+        self._conn_acc: Dict[str, object] = {}
+        self._conn_n: Dict[str, float] = {}
         self.pending: Dict[int, dict] = {}
         self.round_log = []
         self.round_timeout = round_timeout
@@ -499,6 +537,17 @@ class FedAvgServerManager(NodeManager):
 
     # -- protocol --
     def start(self):
+        if self._conn_cap > 0:
+            # arm connection attribution BEFORE the first broadcast
+            # (synchronous pre-run fetch): without it, round 0's
+            # uploads would race the async conn_map reply and a fast
+            # cohort could fold as unmapped singletons — exactly the
+            # window a malicious muxer wants.  Raises on a hub that
+            # cannot answer: a cap the operator configured must never
+            # silently degrade to uncapped.
+            fetch = getattr(self.backend, "fetch_conn_map", None)
+            if fetch is not None:
+                self._robust.set_conn_map(fetch())
         self._round_open_t = time.perf_counter()
         if self.rollup is not None:
             with self._round_lock:
@@ -531,6 +580,14 @@ class FedAvgServerManager(NodeManager):
         identity from their node id.
         """
         nodes = self._sampled_nodes()
+        if self._conn_cap > 0:
+            # refresh connection attribution once per round (async
+            # reply; uploads take a client-train time to come back, so
+            # the map is current by the first fold).  Best-effort —
+            # unattributed nodes degrade to singleton connections.
+            req = getattr(self.backend, "request_conn_map", None)
+            if req is not None:
+                req()
         wire = tree_to_wire(self.variables)  # encode once per round
         if not self.multicast:
             for node in nodes:
@@ -710,6 +767,35 @@ class FedAvgServerManager(NodeManager):
         ):
             self._reject_upload(msg.sender, "corrupt_upload")
             return
+        conn_key = None
+        if self._robust is not None:
+            # robust screening — the norm-space extension of the
+            # non-finite firewall above, same altitude (O(model), host
+            # numpy, OUTSIDE the round lock): outlier-score reject,
+            # norm clip against the broadcast base, client-level DP.
+            # Per-upload math depends only on (upload, base, seed,
+            # round, slot) — never on arrival order — so defended
+            # same-seed runs agree whatever the interleaving.
+            screened, defense_flags = self._robust.screen(
+                variables, base,
+                round_idx=(reply_round if reply_round is not None
+                           else self.round_idx),
+                slot=msg.sender - 1,
+            )
+            if screened is None:
+                self._reject_upload(msg.sender, "outlier_upload")
+                return
+            variables = screened
+            if self._conn_cap > 0:
+                # connection attribution for the contribution cap: the
+                # hub's conn_map introspection is the authority (a
+                # muxer cannot claim independent connections for its
+                # virtual cohort); nodes outside the map count as their
+                # own singleton connection
+                fn = getattr(self.backend, "conn_map", None)
+                if callable(fn):
+                    self._robust.set_conn_map(fn())
+                conn_key = self._robust.conn_key(msg.sender)
         decode_s = time.perf_counter() - t_start
         get_telemetry().observe("span.decode_s", decode_s)
         with self._round_lock:
@@ -734,7 +820,12 @@ class FedAvgServerManager(NodeManager):
                                     kind="duplicate_upload",
                                     msg_type=MSG_TYPE_C2S_SEND_MODEL)
                 return
-            if self.streaming_agg:
+            if self._robust is not None:
+                # defense telemetry counts ACCEPTED uploads only —
+                # after the duplicate check above, so a redelivered
+                # copy's screening never double-counts
+                self._robust.note_upload(defense_flags)
+            if self.streaming_agg and not self._defense_buffered:
                 # fold NOW, under the round lock (a concurrent close
                 # swaps the accumulator; the stale re-check above makes
                 # this fold belong to the open round): pending keeps
@@ -742,14 +833,28 @@ class FedAvgServerManager(NodeManager):
                 # large the cohort, and the close-time aggregation
                 # stall collapses into these per-arrival folds
                 t0 = time.perf_counter()
-                self._agg_acc = treelib.tree_fold_weighted(
-                    self._agg_acc, variables, n
-                )
+                if conn_key is not None:
+                    # contribution caps: one num/den accumulator per
+                    # PHYSICAL connection (O(conns · model)); the close
+                    # rescales any conn over its weight-fraction cap
+                    self._conn_acc[conn_key] = treelib.tree_fold_weighted(
+                        self._conn_acc.get(conn_key), variables, n
+                    )
+                    self._conn_n[conn_key] = (
+                        self._conn_n.get(conn_key, 0.0) + float(n)
+                    )
+                else:
+                    self._agg_acc = treelib.tree_fold_weighted(
+                        self._agg_acc, variables, n
+                    )
                 self._agg_n += float(n)
                 get_telemetry().observe("span.agg_fold_s",
                                         time.perf_counter() - t0)
             else:
-                meta["variables"] = variables  # legacy: buffer the tree
+                # buffered: the legacy baseline arm, or a robust
+                # estimator (median/trimmed-mean) that needs all K
+                # decoded trees at close
+                meta["variables"] = variables
             self.pending[msg.sender] = meta
             if len(self.pending) < self.clients_per_round:
                 return
@@ -789,8 +894,11 @@ class FedAvgServerManager(NodeManager):
             self._deadline_timer.cancel()
         sampled = set(self._sampled_nodes())
         time_agg = 0.0
+        capped_conns = 0
+        cap_infeasible = False
+        streaming_close = self.streaming_agg and not self._defense_buffered
         entries = list(self.pending.values())
-        total = (self._agg_n if self.streaming_agg
+        total = (self._agg_n if streaming_close
                  else sum(e["n"] for e in entries))
         if total <= 0:
             # every reporter was rejected or weightless: same no-op
@@ -803,12 +911,45 @@ class FedAvgServerManager(NodeManager):
             # correction over-sampled/deadline-cut cohorts need — each
             # weight is n_i / sum(n_arrived), never n_i / sum(n_sampled)
             t0 = time.perf_counter()
-            if self.streaming_agg:
+            if streaming_close and self._conn_n:
+                # contribution-capped close: rescale any connection
+                # over its weight-fraction cap (water-filling — capped
+                # conns land at EXACTLY conn_cap of the rescaled
+                # total), then combine the per-conn num/den pairs in
+                # sorted-key order (arrival-order independent)
+                from fedml_tpu.robust import cap_connection_weights
+
+                scales, cap_infeasible = cap_connection_weights(
+                    self._conn_n, self._conn_cap
+                )
+                num, den = None, 0.0
+                for key in sorted(self._conn_acc):
+                    scaled = treelib.tree_scale(self._conn_acc[key],
+                                                scales[key])
+                    num = scaled if num is None else treelib.tree_add(
+                        num, scaled)
+                    den += scales[key] * self._conn_n[key]
+                capped_conns = sum(1 for v in scales.values() if v < 1.0)
+                self.variables = treelib.tree_finalize_weighted_mean(
+                    num, den, self.variables
+                )
+            elif streaming_close:
                 # the whole cohort already folded in on arrival — the
                 # close "stall" is one O(model) normalize, the engine's
                 # exact num/den formulation (sum n·x then /sum n)
                 self.variables = treelib.tree_finalize_weighted_mean(
                     self._agg_acc, total, self.variables
+                )
+            elif self._defense_buffered:
+                # buffered robust close: the usual weighted mean for
+                # the non-params collections, with the params
+                # collection replaced by the coordinate-wise robust
+                # center over the decoded cohort (core.robust's
+                # estimator, numpy host-side — same formula the
+                # compiled transform runs)
+                self.variables = self._robust.buffered_close(
+                    [e["variables"] for e in entries],
+                    [e["n"] / total for e in entries],
                 )
             else:
                 self.variables = treelib.tree_weighted_sum(
@@ -878,6 +1019,13 @@ class FedAvgServerManager(NodeManager):
         rec["decode_wait_s"] = round(self._last_decode_wait_s, 6)
         rec["decode_s"] = round(self._last_decode_s, 6)
         rec["encode_overlap_s"] = round(self._bcast_task_s, 6)
+        if self._robust is not None:
+            # per-round defense activity (clipped / outlier-rejected /
+            # DP-noised / capped conns) next to participants and spans
+            # — what trace_summary's defense section and fed_slo read
+            rec["defense"] = self._robust.note_round(
+                capped=capped_conns, cap_infeasible=cap_infeasible
+            )
         # the same record as a telemetry event: the server's
         # metrics-node0.jsonl then carries round boundaries next to its
         # trace_hop chains, so the timeline merger reads ONE stream
@@ -886,7 +1034,9 @@ class FedAvgServerManager(NodeManager):
                   t_open_m=rec["t_open_m"], t_close_m=rec["t_close_m"],
                   decode_wait_s=rec["decode_wait_s"],
                   decode_s=rec["decode_s"],
-                  encode_overlap_s=rec["encode_overlap_s"])
+                  encode_overlap_s=rec["encode_overlap_s"],
+                  **({"defense": rec["defense"]} if "defense" in rec
+                     else {}))
         if self.slo is not None:
             # stats plane: SLO histograms + evaluation against the
             # merged rollup, while this round's state is still in hand
@@ -898,6 +1048,7 @@ class FedAvgServerManager(NodeManager):
         self.round_log.append(rec)
         self.pending.clear()
         self._agg_acc, self._agg_n = None, 0.0
+        self._conn_acc, self._conn_n = {}, {}
         self._last_decode_wait_s = self._last_decode_s = 0.0
         self.round_idx += 1
         if self.round_idx >= self.comm_rounds:

@@ -25,6 +25,15 @@ FaultRule decisions, trace hop chains, and telemetry identities match
 the per-process topology — a drop rule for virtual node 3 drops only
 node 3's sync copy, and node 3 simply isn't in that round's vmapped
 cohort.
+
+The same wrap is the MALICIOUS-MUXER attack surface
+(``fedml_tpu/robust`` threat model): Byzantine FaultRules (``sign_flip``
+/ ``scale_grad``) covering this muxer's virtual ids mutate every
+co-located upload on its way out — one compromised process speaking for
+a whole cohort through one connection, which is exactly what the
+server's per-connection contribution caps exist to bound (the hub's
+``conn_map`` introspection attributes all these uploads to THIS conn,
+however many virtual identities ride it).
 """
 
 from __future__ import annotations

@@ -517,6 +517,27 @@ class TcpHub:
                           + "\n").encode(),),
                     )
                     continue
+                if frame.get(HUB_KEY) == "conn_map":
+                    # connection-attribution introspection (the robust
+                    # aggregator's anti-Sybil lever): the HUB is the
+                    # authority on which node ids share a physical
+                    # connection — a malicious muxer cannot lie its
+                    # virtual cohort into looking like independent
+                    # connections.  Reply {cid: [node ids]} to the
+                    # requester; one frame per request, no hot-path
+                    # cost for anyone who never asks.
+                    with self._lock:
+                        by_cid: Dict[int, list] = {}
+                        for nid, cst in self._conns.items():
+                            by_cid.setdefault(cst.cid, []).append(nid)
+                    reply = {HUB_KEY: "conn_map",
+                             "conns": {str(c): sorted(v)
+                                       for c, v in by_cid.items()}}
+                    self._forward(
+                        node_id,
+                        ((json.dumps(reply) + "\n").encode(),),
+                    )
+                    continue
                 if frame.get(HUB_KEY) == "stop":
                     break
                 receiver = frame.get("receiver")
@@ -1087,6 +1108,10 @@ class TcpBackend(CommBackend):
         self._reasm_bytes = 0
         self._dead_sids: deque = deque(maxlen=64)  # aborted stream ids
         self._stripe_fault_hook = None
+        # latest hub conn_map reply ({cid: [node ids]}): written only by
+        # the reader thread (atomic reference swap), read by the robust
+        # aggregator's connection attribution — never mutated in place
+        self._conn_map: Optional[dict] = None
         self._dial()
 
     def set_stripe_fault_hook(self, hook) -> None:
@@ -1292,6 +1317,146 @@ class TcpBackend(CommBackend):
         self._record_send(msg, sum(len(p) for p in parts),
                           time.perf_counter() - t0)
 
+    def request_conn_map(self) -> None:
+        """Ask the hub for its node→connection grouping (replied
+        asynchronously; the reader loop stores the latest map, read via
+        ``conn_map``).  Best-effort: a failed request just leaves the
+        previous map in place — connection caps then attribute against
+        slightly stale grouping, which the rebind counters make
+        visible."""
+        line = (json.dumps({HUB_KEY: "conn_map"}) + "\n").encode()
+        try:
+            with self._send_lock:
+                _sendall_parts(self._sock, [line])
+        except OSError:
+            logging.warning("node %d: conn_map request failed",
+                            self.node_id)
+
+    def conn_map(self) -> Optional[dict]:
+        """Latest hub ``{cid: [node ids]}`` reply (None before the
+        first one).  The returned object is never mutated — callers may
+        identity-cache it."""
+        return self._conn_map
+
+    def _sync_hub_replies(self, kind: str, timeout: float, op: str):
+        """Pre-``run()`` synchronous hub-RPC loop — the ONE
+        implementation behind ``await_peers`` and ``fetch_conn_map``:
+        send a ``{__hub__: kind}`` request, read frames off the shared
+        socket, and yield each matching reply (the caller decides
+        whether to return or poll again; resuming the generator
+        re-sends the request).
+
+        Frame discipline while waiting (the part that must not drift
+        between callers): a read timing out MID-frame kills the
+        connection (the buffered reader discarded partial bytes —
+        frame alignment can't be trusted) and raises; other ``__hub__``
+        frames are skipped (stale idempotent replies); an ORDINARY
+        message frame is a genuine delivery — its binary payload is
+        read and the message handed to the observers, NOT dropped (the
+        stats plane's early digest frames queue here while the startup
+        barrier waits on slow-importing clients; eating them would
+        silently lose those intervals from the SLO rollup).  The
+        generator raises ``TimeoutError`` when the budget runs dry."""
+        import time as _time
+
+        deadline = _time.monotonic() + timeout
+        request = (json.dumps({HUB_KEY: kind}) + "\n").encode()
+        try:
+            while (remaining := deadline - _time.monotonic()) > 0:
+                self._sock.settimeout(max(remaining, 0.05))
+                try:
+                    self._sock.sendall(request)
+                except TimeoutError:
+                    # a timed-out sendall may have written PART of the
+                    # request line — the write side is no longer
+                    # frame-aligned, so the socket must not be reused
+                    # (same contract as the mid-frame read below)
+                    self._kill_connection()
+                    raise TimeoutError(
+                        f"node {self.node_id}: hub write timed out mid-"
+                        f"frame during {op}; connection closed"
+                    ) from None
+                except OSError as e:
+                    raise ConnectionError(
+                        f"node {self.node_id}: hub connection failed "
+                        f"during {op}: {e}"
+                    ) from e
+                matched = False
+                while not matched and \
+                        (remaining := deadline - _time.monotonic()) > 0:
+                    self._sock.settimeout(max(remaining, 0.05))
+                    try:
+                        line = self._file.readline()
+                        frame = json.loads(line) if line else None
+                        # a v2 frame announces its binary payload —
+                        # consume it HERE or the next readline would
+                        # parse payload bytes as headers
+                        binlen = (frame.get(FRAME_BINLEN_KEY)
+                                  if isinstance(frame, dict) else None)
+                        payload = self._file.read(binlen) if binlen else b""
+                        if binlen and len(payload) < binlen:
+                            line = b""  # torn frame == EOF
+                    except TimeoutError:
+                        # mid-frame timeout: the stream can no longer
+                        # be trusted frame-aligned (ADVICE r2) — kill
+                        # it so reuse fails loudly instead of corrupting
+                        self._kill_connection()
+                        raise TimeoutError(
+                            f"node {self.node_id}: hub read timed out "
+                            f"mid-frame during {op}; connection closed "
+                            "(a resumed read could split a frame)"
+                        ) from None
+                    except OSError as e:
+                        raise ConnectionError(
+                            f"node {self.node_id}: hub connection failed "
+                            f"during {op}: {e}"
+                        ) from e
+                    if not line:
+                        raise ConnectionError(
+                            f"node {self.node_id}: hub closed during {op}"
+                        )
+                    if frame.get(HUB_KEY) == kind:
+                        matched = True
+                        yield frame
+                    elif HUB_KEY in frame:
+                        continue  # stale idempotent reply of another kind
+                    else:
+                        # genuine early delivery (e.g. a digest frame):
+                        # hand it to the observers on this thread — a
+                        # handler error must not kill the RPC
+                        try:
+                            self._notify(Message.from_frame(frame, payload),
+                                         nbytes=len(line) + len(payload))
+                        except Exception:
+                            logging.exception(
+                                "node %d: early frame delivery failed "
+                                "during %s", self.node_id, op,
+                            )
+        finally:
+            try:
+                self._sock.settimeout(None)
+            except OSError:
+                pass  # _kill_connection already closed it
+
+    def fetch_conn_map(self, timeout: float = 10.0) -> dict:
+        """SYNCHRONOUS conn_map fetch — pre-``run()`` only (the
+        ``_sync_hub_replies`` contract).  The robust aggregator calls
+        this before the first broadcast so connection caps are armed
+        from round 0 instead of racing the async reply; later topology
+        changes ride the per-round ``request_conn_map`` refresh.
+        Raises on timeout — a cap the operator asked for must fail
+        loudly, never silently degrade to uncapped."""
+        for reply in self._sync_hub_replies("conn_map", timeout,
+                                            "fetch_conn_map"):
+            self._conn_map = {
+                int(c): [int(n) for n in nodes]
+                for c, nodes in (reply.get("conns") or {}).items()
+            }
+            return self._conn_map
+        raise TimeoutError(
+            f"node {self.node_id}: no conn_map reply within {timeout}s"
+        )
+
     def drop_connection(self) -> None:
         """Fault injection: sever the hub connection WITHOUT stopping
         the backend — ``run()`` sees EOF and, with ``auto_reconnect``,
@@ -1304,64 +1469,24 @@ class TcpBackend(CommBackend):
     def await_peers(self, ids, timeout: float = 60.0) -> None:
         """Block until every node id in ``ids`` is registered at the hub.
 
-        MUST be called before ``run()`` (it reads replies off the shared
-        socket); pre-protocol, the only inbound frames are peers
-        replies, so the read is unambiguous.  This is the startup
-        barrier: the hub drops frames to unregistered receivers, so a
-        coordinator that broadcasts before its cohort registered would
-        hang the federation.
+        MUST be called before ``run()`` (it reads replies off the
+        shared socket — the ``_sync_hub_replies`` contract; ordinary
+        frames arriving early are DELIVERED to the observers, not
+        dropped).  This is the startup barrier: the hub drops frames to
+        unregistered receivers, so a coordinator that broadcasts before
+        its cohort registered would hang the federation.  A budget
+        spent between full reads leaves the stream frame-aligned and
+        the backend reusable; only a mid-frame timeout kills the
+        connection.
         """
         import time as _time
 
         want = set(int(i) for i in ids)
-        deadline = _time.monotonic() + timeout
-        # Bound each readline by the remaining budget: the socket runs
-        # blocking (timeout None) for the normal message loop, and a hub
-        # that accepts the request but never replies (wedged process)
-        # would otherwise hang this "raises TimeoutError" function forever.
-        try:
-            while (remaining := deadline - _time.monotonic()) > 0:
-                self._sock.settimeout(max(remaining, 0.05))
-                try:
-                    self._sock.sendall(
-                        (json.dumps({HUB_KEY: "peers"}) + "\n").encode()
-                    )
-                    line = self._file.readline()
-                except TimeoutError:
-                    # A timed-out readline (or partial sendall) leaves
-                    # the stream mid-frame: the buffered reader discards
-                    # the partial bytes, so any later read would parse
-                    # the frame's TAIL as a fresh line (ADVICE r2).  The
-                    # connection can no longer be trusted frame-aligned —
-                    # kill it so reuse fails loudly instead of corrupting.
-                    self._kill_connection()
-                    raise TimeoutError(
-                        f"node {self.node_id}: hub read timed out mid-"
-                        "frame during await_peers; connection closed "
-                        "(a resumed read could split a frame)"
-                    ) from None
-                except OSError as e:
-                    # a reset/closed socket is a dead hub, not slow peers
-                    raise ConnectionError(
-                        f"node {self.node_id}: hub connection failed during "
-                        f"await_peers: {e}"
-                    ) from e
-                if not line:
-                    raise ConnectionError(
-                        f"node {self.node_id}: hub closed during await_peers"
-                    )
-                frame = json.loads(line)
-                if frame.get(HUB_KEY) == "peers":
-                    if want <= set(frame.get("ids", [])):
-                        return
-                    _time.sleep(0.05)
-        finally:
-            try:
-                self._sock.settimeout(None)
-            except OSError:
-                pass  # _kill_connection already closed it
-        # budget spent between reads: every readline returned a FULL line,
-        # so the stream is still frame-aligned and the backend is reusable
+        for reply in self._sync_hub_replies("peers", timeout,
+                                            "await_peers"):
+            if want <= set(reply.get("ids", [])):
+                return
+            _time.sleep(0.05)  # poll: resuming re-sends the request
         raise TimeoutError(
             f"node {self.node_id}: peers {sorted(want)} not all registered "
             f"within {timeout}s"
@@ -1471,6 +1596,19 @@ class TcpBackend(CommBackend):
                     # copy, never a dead reader
                     logging.exception("node %d: mux demux failed",
                                       self.node_id)
+                continue
+            if frame.get(HUB_KEY) == "conn_map":
+                # hub introspection reply (request_conn_map): atomic
+                # reference swap — readers (the robust aggregator's
+                # connection attribution) always see a complete map
+                try:
+                    self._conn_map = {
+                        int(c): [int(n) for n in nodes]
+                        for c, nodes in (frame.get("conns") or {}).items()
+                    }
+                except (TypeError, ValueError):
+                    logging.warning("node %d: malformed conn_map reply",
+                                    self.node_id)
                 continue
             try:
                 # exact wire bytes: header line + binary payload

@@ -1,4 +1,4 @@
-"""Robust aggregation defenses.
+"""Robust aggregation defenses — THE one copy of the defense math.
 
 Reference ``fedml_core/robustness/robust_aggregation.py``:
 - ``vectorize_weight`` flattens all parameters EXCLUDING BatchNorm
@@ -7,9 +7,33 @@ Reference ``fedml_core/robustness/robust_aggregation.py``:
   ``norm_bound`` (``:38-49``);
 - weak differential privacy: add N(0, stddev²) noise (``:51-55``).
 
-Here both are pure functions over stacked client variable pytrees,
-usable as the round engine's ``aggregate_transform`` hook so the
-defense runs inside the same compiled program as the psum.
+Classic grounding beyond the reference: coordinate-wise median and
+trimmed mean are the Byzantine-robust estimators of Blanchard et
+al. (NeurIPS 2017) / Yin et al. (ICML 2018); norm clipping + noise is
+the backdoor defense of Sun et al. ("Can You Really Backdoor Federated
+Learning?", 2019).
+
+Every function here is polymorphic over the array module (``xp`` =
+``jax.numpy`` or ``numpy``): the SAME formula runs
+
+- stacked + jit'd inside the compiled round engine as the
+  ``aggregate_transform`` hook (``make_robust_transform``, xp=jnp), and
+- per-upload on the cross-device server's host hot path
+  (``fedml_tpu.robust.defense``, xp=np — no device dispatch under the
+  round lock).
+
+That is the dedup contract: the sim layer and the real-TCP server
+cannot drift because there is no second copy to drift
+(``tests/test_robust_agg.py`` pins np-vs-jnp parity).
+
+Sub-stream discipline: aggregation-defense randomness (weak-DP /
+client-level DP noise) lives on the ``AGG_STREAM`` fold_in sub-stream
+of the round key — ``fold_in(fold_in(fold_in(key, round), AGG_STREAM),
+slot)`` — exactly the per-slot keys ``make_round_fn`` derives for its
+``aggregate_transform`` rngs, so server-side DP noise is bit-identical
+to the compiled engine's weak-DP noise for the same (seed, round,
+slot) and reproducible across processes (the ``compress/`` key
+discipline).
 """
 
 from __future__ import annotations
@@ -21,40 +45,102 @@ import jax.numpy as jnp
 
 PyTree = Any
 
+# fold_in sub-stream indices under the round key (see
+# algorithms/fedavg.make_round_fn and compress/codecs.COMPRESS_STREAM):
+# 0 = training, 1 = aggregation noise (this module), 2 = compression
+AGG_STREAM = 1
 
-def _param_diff_norms(global_params: PyTree, stacked_params: PyTree) -> jax.Array:
-    """[K] L2 norm of (w_i − w_global), over parameters only (BN stats are
-    a separate collection in our variables tree and never enter here)."""
+_NORM_EPS = 1e-12
+
+
+def param_delta_sq_norms(global_params: PyTree, stacked_params: PyTree,
+                         *, xp=jnp):
+    """[K] squared L2 norm of (w_i − w_global), over parameters only (BN
+    stats are a separate collection in our variables tree and never
+    enter here — the reference's ``vectorize_weight`` exclusion)."""
     sq = jax.tree_util.tree_map(
-        lambda g, s: jnp.sum(
-            jnp.square(s.astype(jnp.float32) - g[None].astype(jnp.float32)),
+        lambda g, s: xp.sum(
+            xp.square(s.astype(xp.float32) - g[None].astype(xp.float32)),
             axis=tuple(range(1, s.ndim)),
         ),
         global_params,
         stacked_params,
     )
-    return jnp.sqrt(sum(jax.tree_util.tree_leaves(sq)))
+    return sum(jax.tree_util.tree_leaves(sq))
+
+
+def param_delta_norms(global_params: PyTree, stacked_params: PyTree,
+                      *, xp=jnp):
+    """[K] L2 norm of (w_i − w_global) — see ``param_delta_sq_norms``."""
+    return xp.sqrt(param_delta_sq_norms(global_params, stacked_params, xp=xp))
+
+
+def clip_factor(norms, norm_bound: float, *, xp=jnp):
+    """Per-client clip scale ``min(1, bound / max(norm, eps))`` — the
+    norm-difference-clipping formula, shared by every caller."""
+    return xp.minimum(1.0, norm_bound / xp.maximum(norms, _NORM_EPS))
+
+
+def clip_stacked_params(global_params: PyTree, stacked_params: PyTree,
+                        norm_bound: float, *, xp=jnp) -> PyTree:
+    """Norm-difference clipping over a stacked [K, ...] params tree:
+    ``w_t + scale_k * (w_k − w_t)`` with ``scale_k`` from
+    ``clip_factor``.  Works identically for K=1 host-side screening and
+    a full cohort inside jit."""
+    norms = param_delta_norms(global_params, stacked_params, xp=xp)
+    scale = clip_factor(norms, norm_bound, xp=xp)  # [K]
+    return jax.tree_util.tree_map(
+        lambda g, s: (
+            g[None].astype(xp.float32)
+            + xp.einsum(
+                "k,k...->k...",
+                scale,
+                s.astype(xp.float32) - g[None].astype(xp.float32),
+            )
+        ).astype(s.dtype),
+        global_params,
+        stacked_params,
+    )
 
 
 def clip_client_updates(
-    global_vars: PyTree, stacked_client_vars: PyTree, norm_bound: float
+    global_vars: PyTree, stacked_client_vars: PyTree, norm_bound: float,
+    *, xp=jnp,
 ) -> PyTree:
     """Per-client norm-difference clipping of parameter deltas."""
-    norms = _param_diff_norms(global_vars["params"], stacked_client_vars["params"])
-    scale = jnp.minimum(1.0, norm_bound / jnp.maximum(norms, 1e-12))  # [K]
-    clipped = jax.tree_util.tree_map(
-        lambda g, s: (
-            g[None].astype(jnp.float32)
-            + jnp.einsum(
-                "k,k...->k...",
-                scale,
-                s.astype(jnp.float32) - g[None].astype(jnp.float32),
-            )
-        ).astype(s.dtype),
-        global_vars["params"],
-        stacked_client_vars["params"],
+    clipped = clip_stacked_params(
+        global_vars["params"], stacked_client_vars["params"], norm_bound,
+        xp=xp,
     )
     return {**stacked_client_vars, "params": clipped}
+
+
+def noise_params(key: jax.Array, client_params: PyTree,
+                 stddev: float) -> PyTree:
+    """Gaussian noise on ONE client's parameters — the per-client atom
+    both ``add_weak_dp_noise`` (vmapped, in-jit) and the cross-device
+    server's client-level DP (host-side, per upload) draw from.  Always
+    ``jax.random`` (threefry is exact integer math): the same key gives
+    bit-identical noise in any process."""
+    leaves, treedef = jax.tree_util.tree_flatten(client_params)
+    keys = jax.random.split(key, len(leaves))
+    out = [
+        (l.astype(jnp.float32) + stddev * jax.random.normal(k, l.shape)).astype(
+            l.dtype
+        )
+        for l, k in zip(leaves, keys)
+    ]
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def agg_noise_key(seed_key: jax.Array, round_idx, slot) -> jax.Array:
+    """The aggregation-defense key for (round, GLOBAL slot): the exact
+    per-slot stream ``make_round_fn`` hands its ``aggregate_transform``
+    — one derivation for the engine, the sim and the server."""
+    k_round = jax.random.fold_in(seed_key, round_idx)
+    return jax.random.fold_in(
+        jax.random.fold_in(k_round, AGG_STREAM), slot
+    )
 
 
 def add_weak_dp_noise(
@@ -66,20 +152,59 @@ def add_weak_dp_noise(
     round engine) so noise is independent per client even when the
     client block is sharded across devices.
     """
-
-    def noise_one(key, client_params):
-        leaves, treedef = jax.tree_util.tree_flatten(client_params)
-        keys = jax.random.split(key, len(leaves))
-        out = [
-            (l.astype(jnp.float32) + stddev * jax.random.normal(k, l.shape)).astype(
-                l.dtype
-            )
-            for l, k in zip(leaves, keys)
-        ]
-        return jax.tree_util.tree_unflatten(treedef, out)
-
-    noised = jax.vmap(noise_one)(rngs, stacked_client_vars["params"])
+    noised = jax.vmap(lambda k, p: noise_params(k, p, stddev))(
+        rngs, stacked_client_vars["params"]
+    )
     return {**stacked_client_vars, "params": noised}
+
+
+def coordinate_median(stacked_params: PyTree, *, xp=jnp) -> PyTree:
+    """Coordinate-wise median across the client axis: [K, ...] → [...].
+    The Byzantine-robust location estimator — up to ⌈K/2⌉−1 arbitrary
+    uploads move each coordinate at most to the next honest value."""
+    return jax.tree_util.tree_map(
+        lambda s: xp.median(s.astype(xp.float32), axis=0).astype(s.dtype),
+        stacked_params,
+    )
+
+
+def trimmed_mean(stacked_params: PyTree, trim_frac: float,
+                 *, xp=jnp) -> PyTree:
+    """Coordinate-wise trimmed mean: sort each coordinate across the K
+    clients, drop ``floor(trim_frac * K)`` from EACH end, average the
+    rest.  ``trim_frac`` < 0.5; robust to that fraction of Byzantine
+    clients per coordinate (Yin et al. 2018)."""
+    if not 0.0 <= trim_frac < 0.5:
+        raise ValueError(f"trim_frac must be in [0, 0.5): {trim_frac!r}")
+
+    def one(s):
+        k = s.shape[0]
+        # trim_frac < 0.5 guarantees 2·cut < k for every k >= 1, so at
+        # least one row always survives the trim
+        cut = int(trim_frac * k)
+        srt = xp.sort(s.astype(xp.float32), axis=0)
+        kept = srt[cut:k - cut] if cut else srt
+        return xp.mean(kept, axis=0).astype(s.dtype)
+
+    return jax.tree_util.tree_map(one, stacked_params)
+
+
+def robust_center(defense_type: str, stacked_params: PyTree,
+                  *, trim_frac: float = 0.2, xp=jnp) -> PyTree:
+    """The buffered-mode estimator dispatch: one name → one formula,
+    used verbatim by the sim transform (xp=jnp, in-jit) and the
+    cross-device server's buffered close (xp=np, host-side)."""
+    if defense_type == "median":
+        return coordinate_median(stacked_params, xp=xp)
+    if defense_type == "trimmed_mean":
+        return trimmed_mean(stacked_params, trim_frac, xp=xp)
+    raise ValueError(
+        f"unknown buffered defense {defense_type!r} "
+        "(expected 'median' or 'trimmed_mean')"
+    )
+
+
+DEFENSE_TYPES = ("norm_diff_clipping", "weak_dp", "median", "trimmed_mean")
 
 
 def make_robust_transform(
@@ -87,22 +212,39 @@ def make_robust_transform(
     *,
     norm_bound: float = 30.0,
     stddev: float = 0.025,
+    trim_frac: float = 0.2,
 ):
     """Aggregate-transform hook: (old_vars, stacked, weights, rngs[K]) → stacked.
 
     Defense knobs mirror the reference CLI
     (``main_fedavg_robust.py:56-62``): ``norm_diff_clipping`` or
-    ``weak_dp`` (which clips then noises, ``FedAvgRobustAggregator.py:166-220``).
+    ``weak_dp`` (which clips then noises, ``FedAvgRobustAggregator.py:166-220``)
+    — plus the buffered Byzantine estimators ``median`` /
+    ``trimmed_mean``, expressed in the SAME hook shape: every client's
+    entry is replaced by the robust center, so the engine's downstream
+    weighted mean of identical entries IS the center and one hook
+    signature serves all four defenses.
     """
 
-    if defense_type not in ("norm_diff_clipping", "weak_dp"):
+    if defense_type not in DEFENSE_TYPES:
         raise ValueError(
             f"unknown defense_type {defense_type!r}; "
-            "expected 'norm_diff_clipping' or 'weak_dp'"
+            f"expected one of {DEFENSE_TYPES}"
         )
 
     def transform(global_vars, stacked, weights, rngs):
         del weights
+        if defense_type in ("median", "trimmed_mean"):
+            center = robust_center(
+                defense_type, stacked["params"], trim_frac=trim_frac
+            )
+            broadcast = jax.tree_util.tree_map(
+                lambda c, s: jnp.broadcast_to(c[None], s.shape).astype(
+                    s.dtype
+                ),
+                center, stacked["params"],
+            )
+            return {**stacked, "params": broadcast}
         stacked = clip_client_updates(global_vars, stacked, norm_bound)
         if defense_type == "weak_dp":
             stacked = add_weak_dp_noise(stacked, rngs, stddev)

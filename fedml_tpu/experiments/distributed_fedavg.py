@@ -297,6 +297,32 @@ def run_server(args) -> None:
         from fedml_tpu.obs.slo import SloSpec
 
         slo_spec = SloSpec.from_arg(args.slo)
+    # robust aggregation (fedml_tpu/robust): --defense picks the mode,
+    # the numeric knobs parametrize it; all-defaults = None = the exact
+    # undefended code path
+    defense = None
+    if args.trim_frac != 0.2 and args.defense != "trimmed_mean":
+        # DefenseConfig cannot tell an explicit 0.2 from the default,
+        # so the only layer that knows the flag was TYPED is this one —
+        # a trim fraction without its mode must not be silently inert
+        raise SystemExit(
+            "--trim-frac only applies with --defense trimmed_mean "
+            f"(got --defense {args.defense})"
+        )
+    if (args.defense != "none" or args.dp_clip > 0 or args.dp_noise > 0
+            or args.norm_bound > 0 or args.outlier_mult > 0
+            or args.conn_cap > 0):
+        # ANY defense knob constructs the config, so a knob that needs
+        # a mode it wasn't given fails DefenseConfig validation loudly
+        # instead of running a silently-undefended federation
+        from fedml_tpu.robust import DefenseConfig
+
+        defense = DefenseConfig(
+            defense=args.defense, norm_bound=args.norm_bound,
+            outlier_mult=args.outlier_mult, conn_cap=args.conn_cap,
+            dp_clip=args.dp_clip, dp_noise=args.dp_noise,
+            trim_frac=args.trim_frac,
+        )
     server = FedAvgServerManager(
         backend, init, num_clients=args.num_clients,
         clients_per_round=args.clients_per_round or args.num_clients,
@@ -319,6 +345,7 @@ def run_server(args) -> None:
         slo_spec=slo_spec,
         status_dir=args.run_dir or None,
         stats_interval=args.report_interval,
+        defense=defense,
     )
     # startup barrier: the hub drops frames to unregistered receivers,
     # so broadcasting before every client registered would hang
@@ -367,7 +394,7 @@ def run_server(args) -> None:
         # health campaign asserts on this line
         "stats_plane": server.stats_summary(),
         "faults": {k: v for k, v in snap.items()
-                   if k.startswith(("faults.", "comm.unhandled",
+                   if k.startswith(("faults.", "robust.", "comm.unhandled",
                                     "comm.send_retries", "comm.send_failed",
                                     "comm.reconnects"))},
         # exact server-side wire accounting (TcpBackend counts header +
@@ -552,6 +579,13 @@ def launch(
     stats_plane: str = "on",
     report_interval: float = 1.0,
     slo: str = "",
+    defense: str = "none",
+    norm_bound: float = 0.0,
+    outlier_mult: float = 0.0,
+    conn_cap: float = 0.0,
+    dp_clip: float = 0.0,
+    dp_noise: float = 0.0,
+    trim_frac: float = 0.2,
     info=None,
     env=None,
     server_env=None,
@@ -719,8 +753,22 @@ def launch(
         # clients — e.g. aggregation on the one real TPU chip while 16
         # client processes train on CPU (only one process may hold the
         # tunnel lease)
+        # robust-aggregation knobs ride the SERVER invocation only (the
+        # defense is a server-side decision; clients stay oblivious)
+        defense_flags = []
+        if defense != "none":
+            defense_flags += ["--defense", defense]
+        for flag, val, dflt in (("--norm-bound", norm_bound, 0.0),
+                                ("--outlier-mult", outlier_mult, 0.0),
+                                ("--conn-cap", conn_cap, 0.0),
+                                ("--dp-clip", dp_clip, 0.0),
+                                ("--dp-noise", dp_noise, 0.0),
+                                ("--trim-frac", trim_frac, 0.2)):
+            if val != dflt:
+                defense_flags += [flag, str(val)]
         server = subprocess.Popen(
-            me + ["--role", "server", "--out", out_path] + common,
+            me + ["--role", "server", "--out", out_path] + common
+            + defense_flags,
             env=dict(server_env) if server_env is not None else env,
             stdout=subprocess.PIPE if info is not None else None,
             text=True if info is not None else None,
@@ -898,6 +946,23 @@ def main(argv=None):
     p.add_argument("--slo", default="",
                    help="SLO spec: inline JSON or a path to a JSON file "
                         "(obs/slo.SloSpec fields)")
+    # robust-aggregation knobs (fedml_tpu/robust; server role):
+    # --defense streaming = per-upload norm clip (--norm-bound) +
+    # outlier-score reject (--outlier-mult, in units of the bound) +
+    # per-connection contribution caps (--conn-cap, fraction of round
+    # weight — the anti-Sybil lever for muxed cohorts); --defense
+    # median|trimmed_mean = buffered coordinate-wise Byzantine
+    # estimators (--trim-frac per side).  --dp-clip/--dp-noise layer
+    # client-level DP (delta clip + seeded gaussian noise) on any mode.
+    p.add_argument("--defense",
+                   choices=["none", "streaming", "median", "trimmed_mean"],
+                   default="none")
+    p.add_argument("--norm-bound", type=float, default=0.0)
+    p.add_argument("--outlier-mult", type=float, default=0.0)
+    p.add_argument("--conn-cap", type=float, default=0.0)
+    p.add_argument("--dp-clip", type=float, default=0.0)
+    p.add_argument("--dp-noise", type=float, default=0.0)
+    p.add_argument("--trim-frac", type=float, default=0.2)
     args = p.parse_args(argv)
     if args.trace:
         # before any comm import reads (and caches) the switch

@@ -13,21 +13,24 @@ process orchestration: ``experiments/distributed_fedavg.py`` and
 
 from fedml_tpu.faults.plan import (
     ACTIONS,
+    ATTACK_ACTIONS,
     DEFAULT_FAULTABLE,
     ENV_VAR,
     FaultPlan,
     FaultRule,
     FaultSpec,
 )
-from fedml_tpu.faults.chaos import ChaosBackend, corrupt_message
+from fedml_tpu.faults.chaos import ChaosBackend, attack_message, corrupt_message
 
 __all__ = [
     "ACTIONS",
+    "ATTACK_ACTIONS",
     "DEFAULT_FAULTABLE",
     "ENV_VAR",
     "ChaosBackend",
     "FaultPlan",
     "FaultRule",
     "FaultSpec",
+    "attack_message",
     "corrupt_message",
 ]

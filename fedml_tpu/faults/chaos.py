@@ -80,6 +80,71 @@ def _nan_leaf_twin(leaf) -> Optional[object]:
     return None
 
 
+def _scaled_leaf_twin(leaf, factor: float) -> Optional[object]:
+    """COPY of one wiretree leaf with every float payload multiplied by
+    ``factor`` (the Byzantine upload mutation), or None if the leaf
+    holds no float payload.  Same leaf-form coverage as
+    ``_nan_leaf_twin``: v1 b64 dicts, v2 raw arrays, and codec entries
+    — for codecs every float sub-array scales (decode is linear in
+    each: qsgd/bf16 scales, top-k values), so the DECODED update is
+    exactly ``factor ×`` the honest one."""
+    from fedml_tpu.comm.message import _np_dtype
+
+    def scaled(a: np.ndarray) -> np.ndarray:
+        # promote-multiply-cast: ml_dtypes (bf16) payloads survive
+        return (np.asarray(a, np.float32) * factor).astype(a.dtype)
+
+    if isinstance(leaf, dict) and NDARRAY_KEY in leaf:
+        dt = _np_dtype(leaf.get("dtype", "float32"))
+        if not _is_float_dtype(dt):
+            return None
+        buf = np.frombuffer(
+            base64.b64decode(leaf[NDARRAY_KEY]), dtype=dt
+        ).reshape(leaf.get("shape") or ())
+        return {**leaf,
+                NDARRAY_KEY: base64.b64encode(
+                    scaled(buf).tobytes()).decode()}
+    if isinstance(leaf, dict) and "enc" in leaf:
+        enc = dict(leaf["enc"])
+        hit = False
+        for name, arr in leaf["enc"].items():
+            a = np.asarray(arr)
+            if _is_float_dtype(a.dtype):
+                enc[name] = scaled(a)
+                hit = True
+        return {**leaf, "enc": enc} if hit else None
+    a = np.asarray(leaf) if hasattr(leaf, "dtype") else None
+    if a is not None and _is_float_dtype(a.dtype):
+        return scaled(a)
+    return None
+
+
+def attack_message(msg: Message, factor: float) -> Optional[Message]:
+    """Copy-on-write Byzantine mutation: multiply EVERY float leaf of
+    the first wire pytree in the params (the model payload) by
+    ``factor`` — ``-1`` is the sign-flip attack, ``±k`` the
+    scaled-gradient attack.  Unlike ``corrupt_message`` (one NaN leaf,
+    caught by the finite firewall) the result is FINITE and plausible:
+    only the robust aggregation layer can bound or reject it.  Returns
+    the mutated COPY, or None if nothing mutable — shared param dicts
+    are never touched in place."""
+    for key, value in msg.params.items():
+        if not (isinstance(value, dict) and WIRETREE_KEY in value):
+            continue
+        leaves = value.get("leaves") or []
+        new_leaves = [
+            (t if t is not None else l)
+            for l, t in ((l, _scaled_leaf_twin(l, factor)) for l in leaves)
+        ]
+        if all(t is l for t, l in zip(new_leaves, leaves)):
+            continue
+        twin = Message()
+        twin.params = dict(msg.params)
+        twin.params[key] = {**value, "leaves": new_leaves}
+        return twin
+    return None
+
+
 def corrupt_message(msg: Message, rng) -> Optional[Message]:
     """Copy-on-write payload corruption: NaN-fill one float leaf of the
     first wire pytree found in the params (the model payload).  Returns
@@ -251,6 +316,15 @@ class ChaosBackend(CommBackend):
                 if twin is not None:
                     msg = twin
                     self._inject("corrupt", msg_type)
+            elif kind in ("sign_flip", "scale_grad"):
+                # Byzantine upload mutation (finite, plausible — the
+                # finite firewall will NOT catch it; that is the point)
+                factor = (-1.0 if kind == "sign_flip"
+                          else float(a.get("attack_scale", 10.0)))
+                twin = attack_message(msg, factor)
+                if twin is not None:
+                    msg = twin
+                    self._inject(kind, msg_type)
             elif kind == "duplicate":
                 self._inject("duplicate", msg_type)
                 # the extra copy gets its own trace identity (copy+1,

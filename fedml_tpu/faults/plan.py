@@ -32,8 +32,18 @@ import os
 import random
 from typing import Dict, Optional, Sequence, Tuple
 
-# actions a plan can inject on a message path
-ACTIONS = ("drop", "delay", "duplicate", "reorder", "corrupt", "disconnect")
+# actions a plan can inject on a message path.  The last two are the
+# ADVERSARIAL (Byzantine) mutations, not transport faults: sign_flip
+# multiplies every float leaf of a model payload by -1, scale_grad by
+# ``attack_scale`` — the classic malicious-client upload mutations
+# (Blanchard et al. 2017's omniscient adversary family) the robust
+# aggregation layer (``fedml_tpu/robust``) defends against.  A rule set
+# covering every virtual node of one muxer IS the malicious-muxer
+# (Sybil) scenario: one compromised process mutating a whole cohort's
+# uploads through one connection.
+ACTIONS = ("drop", "delay", "duplicate", "reorder", "corrupt",
+           "disconnect", "sign_flip", "scale_grad")
+ATTACK_ACTIONS = ("sign_flip", "scale_grad")
 
 # message types faultable by default: the model-bearing control plane.
 # S2C_FINISH is deliberately exempt — dropping it leaves a client's
@@ -97,11 +107,21 @@ class FaultRule:
     receiver: Optional[int] = None
     delay_msgs: int = 1
     delay_s: float = 0.05
+    # adversarial mutations only: the multiplier scale_grad applies to
+    # every float leaf of the upload (sign_flip is a fixed -1; a
+    # NEGATIVE attack_scale composes both — the "scaled sign-flip"
+    # arm of the robust-aggregation evidence campaign)
+    attack_scale: float = 10.0
 
     def __post_init__(self):
         if self.action not in ACTIONS:
             raise ValueError(
                 f"unknown fault action {self.action!r} (one of {ACTIONS})"
+            )
+        if self.action in ATTACK_ACTIONS and self.direction == "stripe":
+            raise ValueError(
+                f"{self.action} is a whole-payload mutation; stripe "
+                "granularity only supports drop|corrupt"
             )
         if self.direction not in ("send", "recv", "stripe"):
             raise ValueError(
@@ -208,6 +228,7 @@ class FaultPlan:
                     "action": rule.action,
                     "delay_msgs": rule.delay_msgs,
                     "delay_s": rule.delay_s,
+                    "attack_scale": rule.attack_scale,
                 })
         # the probabilistic mixes model whole-message faults — stripe
         # decisions come from explicit stripe rules only

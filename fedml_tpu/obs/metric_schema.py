@@ -50,6 +50,10 @@ COUNTERS = {
     "slo.evaluations": "SLO evaluation passes (one per closed round)",
     "faults.injected": "chaos-layer injections {action=,msg_type=}",
     "faults.observed": "tolerance-layer observations {kind=,msg_type=}",
+    "robust.clipped_uploads": "uploads clipped against the broadcast base (norm bound or DP clip)",
+    "robust.dp_noised_uploads": "uploads given client-level DP clip+noise",
+    "robust.capped_conns": "connections rescaled by the contribution cap",
+    "robust.cap_infeasible": "rounds where the conn cap was unsatisfiable (left unapplied, loudly)",
     "rounds.degraded": "rounds closed under the aggregation target",
     "jax.compiles": "jit compilations per instrumented fn {fn=}",
     "jax.backend_compile_events": "runtime jax.monitoring compile events {event=}",
@@ -84,6 +88,7 @@ HISTOGRAMS = {
     "span.agg_s": "close-time aggregation (buffered mode / normalize)",
     "span.server_round_s": "server round wall time, open to close",
     "span.reconnect_s": "outage span, first EOF to re-registered",
+    "robust.upload_norm": "L2 norm of each decoded upload's delta vs the broadcast base",
     "span.traced_round_s": "per-round synced seconds under trace_rounds",
     "slo.round_wall_s": "server round wall (open->close) — the SLO percentile source",
     "slo.round_bytes": "server-visible comm bytes folded per round (sent+recv delta)",

@@ -73,6 +73,13 @@ class SloSpec:
     min_participation: Optional[float] = None  # last round's arrived/target >=
     max_stale_uploads: Optional[int] = None    # cumulative stale rejects <=
     max_corrupt_uploads: Optional[int] = None  # cumulative corrupt rejects <=
+    # robust-aggregation budget: outlier-score rejects (the streaming
+    # defense's norm-space firewall) — a quiet ongoing attack then
+    # shows up as an SLO VIOLATION, not just a counter someone has to
+    # go looking for.  Counted per DELIVERED copy, like every
+    # faults.observed kind (a chaos-duplicated hostile upload is two
+    # hostile frames observed) — size the budget accordingly.
+    max_outlier_uploads: Optional[int] = None  # cumulative outlier rejects <=
     max_degraded_rounds: Optional[int] = None  # cumulative degraded rounds <=
     max_stale_streams: Optional[int] = None    # silent/missing reporters <=
     # staleness threshold for reporter streams; None = derive it from
@@ -214,6 +221,10 @@ class SloEngine:
               self._counter_sum(rollup_digest,
                                 "faults.observed{kind=corrupt_upload"),
               spec.max_corrupt_uploads)
+        check("outlier_uploads",
+              self._counter_sum(rollup_digest,
+                                "faults.observed{kind=outlier_upload"),
+              spec.max_outlier_uploads)
         check("degraded_rounds",
               self._counter_sum(rollup_digest, "rounds.degraded"),
               spec.max_degraded_rounds)
@@ -319,6 +330,8 @@ class SloEngine:
                     rollup_digest, "faults.observed{kind=stale_upload"),
                 "corrupt_uploads": self._counter_sum(
                     rollup_digest, "faults.observed{kind=corrupt_upload"),
+                "outlier_uploads": self._counter_sum(
+                    rollup_digest, "faults.observed{kind=outlier_upload"),
                 "degraded_rounds": self._counter_sum(
                     rollup_digest, "rounds.degraded"),
             },

@@ -221,9 +221,9 @@ def test_clip_bounds_update_norm():
         lambda g: jnp.stack([g + 10.0, g + 0.001]), gvars
     )
     clipped = clip_client_updates(gvars, stacked, norm_bound=1.0)
-    from fedml_tpu.core.robust import _param_diff_norms
+    from fedml_tpu.core.robust import param_delta_norms
 
-    norms = _param_diff_norms(gvars["params"], clipped["params"])
+    norms = param_delta_norms(gvars["params"], clipped["params"])
     assert float(norms[0]) <= 1.0 + 1e-4  # big update clipped to bound
     assert float(norms[1]) < 0.1  # small update untouched
 

@@ -29,7 +29,7 @@ import sys
 PREFIXES = (
     "BENCH_", "FEDLAT_", "FEDSCALE_", "FEDTRACE_", "FEDHEALTH_",
     "FAULTS_", "CONVERGENCE_", "COMPRESS_", "MULTICHIP_", "SCALING_",
-    "FEDERATION_",
+    "FEDERATION_", "ROBUST_",
 )
 
 _ROUND_RE = re.compile(r"[_-]r(\d+)")
@@ -143,6 +143,16 @@ def _extract(doc: dict, fname: str) -> dict:
                             f"arms.{arm}.round_wall_s.p50"))
             if v is not None:
                 out[f"p50[{arm}]"] = v
+    elif fname.startswith("ROBUST_"):
+        for k in ("honest_acc", "undefended_acc_at_30pct",
+                  "defended_acc_at_30pct", "latency_ratio",
+                  "muxer_defended_acc"):
+            v = _num(_deep_get(doc, f"verdict.{k}"))
+            if v is not None:
+                out[k] = v
+        ok = _deep_get(doc, "verdict.ok")
+        if ok is not None:
+            out["ok"] = bool(ok)
     elif fname.startswith("FAULTS_"):
         scenarios = doc.get("scenarios")
         if isinstance(scenarios, list):

@@ -33,6 +33,16 @@ round with a finite global model.  Default matrix:
                          empty-round guard must keep the survivors
                          NaN-free and the degradation visible
                          (rounds.degraded)
+    telemetry_loss       one node loses every digest frame; rounds
+                         untouched, the SLO report names the dark node
+    malicious_client     one client uploads x-25 scaled-gradient
+                         mutations every round; the streaming defense's
+                         outlier reject must exclude them (counted
+                         faults.observed{kind=outlier_upload})
+    malicious_muxer      one muxer sign-flips its WHOLE virtual
+                         cohort's uploads (the PR-10 Sybil surface);
+                         norm clipping + per-connection contribution
+                         caps must keep the aggregate finite
 
 Per scenario the output records: survived, rounds completed, rounds
 aggregated empty (``zero_participant_rounds``), degraded rounds,
@@ -66,7 +76,7 @@ def _worker_env():
     return env
 
 
-def _scenarios(round_timeout: float):
+def _scenarios(round_timeout: float, num_clients: int = 3):
     """name -> launch() kwargs.  Every faulted arm runs with a round
     deadline: without one a single lost upload wedges the federation
     forever (the exact failure mode this subsystem exists to kill)."""
@@ -111,6 +121,28 @@ def _scenarios(round_timeout: float):
         seed=0,
         rules=[FaultRule(action="drop", node=2,
                          msg_type="C2S_TELEMETRY", direction="send")],
+        roles=("client",),
+    ).to_json()
+    # Byzantine arms (fedml_tpu/robust): a scaled-gradient malicious
+    # client (x-25: sign-flipped AND amplified — norm ~25x honest, so
+    # the streaming outlier reject must fire every round), and a
+    # malicious MUXER sign-flipping its whole virtual cohort's uploads
+    # through one connection (the PR-10 Sybil surface) — conn caps +
+    # norm clipping must bound it.  Both finite: the non-finite
+    # firewall never sees them; only the defense layer can.
+    malicious_client_plan = FaultPlan(
+        seed=0,
+        rules=[FaultRule(action="scale_grad", node=3,
+                         msg_type="C2S_SEND_MODEL", direction="send",
+                         attack_scale=-25.0)],
+        roles=("client",),
+    ).to_json()
+    muxed_half = (num_clients + 1) // 2
+    malicious_muxer_plan = FaultPlan(
+        seed=0,
+        rules=[FaultRule(action="sign_flip", node=n,
+                         msg_type="C2S_SEND_MODEL", direction="send")
+               for n in range(1, muxed_half + 1)],
         roles=("client",),
     ).to_json()
     return {
@@ -167,6 +199,36 @@ def _scenarios(round_timeout: float):
             # engine's startup grace = one threshold of uptime)
             "slo": json.dumps({"max_stale_streams": 0,
                                "stale_after_s": 1.5}),
+        },
+        # the x-25 attacker's every upload must be outlier-rejected
+        # (counted, never folded), the round closing by deadline with
+        # the honest reporters — accuracy within noise of fault_free
+        "malicious_client": {
+            "chaos_plan": malicious_client_plan,
+            "round_timeout": round_timeout,
+            "defense": "streaming",
+            "norm_bound": 2.0,
+            "outlier_mult": 3.0,
+        },
+        # one muxer sign-flips its whole co-located cohort (half the
+        # federation) through ONE connection: norm clipping bounds each
+        # upload, the conn cap bounds the connection's total weight —
+        # the aggregate must stay finite and the run NaN-free
+        # conn_cap 0.5, not lower: at 3 clients the topology has only
+        # TWO client connections (the muxer + one dialer), and a cap
+        # below 1/2 is unsatisfiable by construction — the engine
+        # refuses it loudly (robust.cap_infeasible) rather than
+        # half-applying.  norm_bound 1.0 (~5x the honest delta norm):
+        # a clipped sign-flip cannot cross zero, only shrink.
+        "malicious_muxer": {
+            "muxers": 1,
+            "muxed_clients": -1,  # resolved to ceil(N/2) in run_scenario
+            "chaos_plan": malicious_muxer_plan,
+            "round_timeout": round_timeout,
+            "defense": "streaming",
+            "norm_bound": 1.0,
+            "outlier_mult": 6.0,
+            "conn_cap": 0.5,
         },
     }
 
@@ -287,7 +349,7 @@ def main(argv=None) -> int:
                    help="per-scenario hard cap on the server process")
     args = p.parse_args(argv)
 
-    scenarios = _scenarios(args.round_timeout)
+    scenarios = _scenarios(args.round_timeout, args.num_clients)
     if args.scenario:
         if args.scenario not in scenarios:
             print(f"unknown scenario {args.scenario!r}; "

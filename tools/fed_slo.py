@@ -104,7 +104,7 @@ def render_status(status: dict, report=None) -> str:
     interesting = {k: v for k, v in sorted(counters.items())
                    if k.startswith(("rounds.", "faults.observed",
                                     "comm.reconnects", "digest.",
-                                    "slo.violations"))}
+                                    "robust.", "slo.violations"))}
     if interesting:
         lines.append("rollup counters (merged across the federation):")
         for k, v in list(interesting.items())[:20]:

@@ -119,7 +119,7 @@ def summarize(records: List[dict]) -> dict:
     # fault/degradation series (the chaos layer's accounting): injected
     # faults, what the tolerance layer observed, degraded rounds, and
     # the comm-resilience counters (retries/reconnects/hub drops)
-    _FAULT_PREFIXES = ("faults.", "hub.", "rounds.")
+    _FAULT_PREFIXES = ("faults.", "hub.", "rounds.", "robust.")
     _FAULT_COMM = ("comm.unhandled_msgs", "comm.send_retries",
                    "comm.send_failed", "comm.reconnects")
     if telemetry:
@@ -152,10 +152,13 @@ def summarize(records: List[dict]) -> dict:
                     "mean_s": hist.get("mean"),
                     "max_s": hist.get("max"),
                 }
-            elif name in ("span.reconnect_s", "span.server_round_s"):
+            elif name in ("span.reconnect_s", "span.server_round_s",
+                          "robust.upload_norm"):
                 # recovery spans: how long nodes were off the hub / how
                 # long the server's rounds ran open (deadline closes
-                # show up as max ~= round_timeout)
+                # show up as max ~= round_timeout); robust.upload_norm
+                # is the defense layer's delta-norm distribution (an
+                # attack shows up as max >> mean)
                 faults[key] = {
                     "count": hist.get("count"),
                     "mean_s": hist.get("mean"),
@@ -165,6 +168,17 @@ def summarize(records: List[dict]) -> dict:
     # degraded/resume events ride the record stream (kind-tagged)
     fault_events = [r for r in records
                     if r.get("kind") in ("degraded_round", "resume")]
+
+    # per-round defense activity (robust aggregation): round_close
+    # events carry a ``defense`` dict when a defense is configured —
+    # clipped / outlier-rejected / DP-noised uploads and capped
+    # connections, per round, next to the cumulative robust.* counters
+    defense_rounds = [
+        {"round": r.get("round"), **r["defense"]}
+        for r in records
+        if r.get("kind") == "round_close" and isinstance(
+            r.get("defense"), dict)
+    ]
 
     # round latency from the server round_log close stamps ("t"): the
     # delta between consecutive closes is one round's wall time — the
@@ -223,6 +237,7 @@ def summarize(records: List[dict]) -> dict:
         "compression": compression,
         "faults": faults,
         "fault_events": fault_events,
+        "defense_rounds": defense_rounds,
         "compiles": [
             {k: c.get(k) for k in ("ts", "fn", "signature", "seconds")}
             for c in compiles
@@ -337,14 +352,26 @@ def render_text(path: str, s: dict, max_round_rows: int = 30) -> None:
         for key in sorted(s.get("faults") or {}):
             v = s["faults"][key]
             if isinstance(v, dict):
+                # robust.upload_norm is a unitless L2 norm, not seconds
+                fmt = ((lambda x: "-" if x is None else f"{x:g}")
+                       if "upload_norm" in key else _fmt_s)
                 print(f"    {key}: count={v.get('count')} "
-                      f"mean={_fmt_s(v.get('mean_s'))} "
-                      f"max={_fmt_s(v.get('max_s'))}")
+                      f"mean={fmt(v.get('mean_s'))} "
+                      f"max={fmt(v.get('max_s'))}")
             else:
                 print(f"    {key} = {v:g}")
         for ev in s.get("fault_events") or []:
             extra = {k: v for k, v in ev.items() if k not in ("kind", "ts")}
             print(f"    event {ev.get('kind')}: {extra}")
+
+    if s.get("defense_rounds"):
+        print("\n  robust aggregation (per round):")
+        print("    round  clipped  outliers  dp_noised  capped_conns")
+        for d in s["defense_rounds"]:
+            print(f"    {str(d.get('round')):<6} {d.get('clipped', 0):<8} "
+                  f"{d.get('outliers', 0):<9} {d.get('dp_noised', 0):<10} "
+                  f"{d.get('capped_conns', 0)}"
+                  + ("  CAP-INFEASIBLE" if d.get("cap_infeasible") else ""))
 
     if s["gauges"]:
         print("\n  gauges:")

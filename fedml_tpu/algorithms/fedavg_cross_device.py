@@ -30,15 +30,18 @@ from fedml_tpu.analysis.locks import assert_held, make_lock
 from fedml_tpu.comm.backend import CommBackend, NodeManager
 from fedml_tpu.comm.message import (
     MSG_ARG_KEY_CLIENT_INDEX,
+    MSG_ARG_KEY_DELTA_BASE,
     MSG_ARG_KEY_LOCAL_METRICS,
     MSG_ARG_KEY_MODEL_PARAMS,
     MSG_ARG_KEY_NUM_SAMPLES,
     MSG_ARG_KEY_ROUND_INDEX,
+    MSG_TYPE_C2S_RESYNC,
     MSG_TYPE_C2S_SEND_MODEL,
     MSG_TYPE_C2S_TELEMETRY,
     MSG_TYPE_S2C_FINISH,
     MSG_TYPE_S2C_INIT_CONFIG,
     MSG_TYPE_S2C_SYNC_MODEL,
+    WIRETREE_KEY,
     Message,
     tree_from_wire,
     tree_is_delta,
@@ -123,6 +126,135 @@ def ef_for(store: dict, key, codec_name: str, enabled: bool):
     return ef
 
 
+def apply_bcast_delta(base_tree, delta_tree):
+    """ONE addition formula for the delta-broadcast chain, shared by
+    the server (advancing its canonical model), the per-process client,
+    and the muxer's cohort manager.  The chain's byte-identity claim —
+    every receiver reconstructs EXACTLY the model the server holds,
+    and a delta run's final model equals a full-broadcast run's at the
+    same chain codec — rests on all ends computing ``base + delta``
+    with the same ops in the same order, so the formula lives here,
+    once: fp32 add, cast back to the base leaf's dtype."""
+    return jax.tree_util.tree_map(
+        lambda b, d: np.asarray(
+            np.asarray(b, np.float32) + np.asarray(d, np.float32),
+            np.asarray(b).dtype,
+        ),
+        base_tree, delta_tree,
+    )
+
+
+def encode_bcast_delta(codec_name: str, update_tree, *, seed: int,
+                       round_idx: int) -> dict:
+    """Encode one chain update as a delta-flagged wiretree.  ``qsgd8``
+    (the default) is the int8 lever; ``none`` ships raw fp32 leaves
+    (the lossless arm — same bytes as full, proves the protocol).  The
+    encode key is the dedicated broadcast stream
+    ``fold_in(fold_in(seed_key, round), BCAST_STREAM)`` — disjoint from
+    every per-client upload stream, and a pure function of
+    (seed, round): the encoded bytes are bit-identical across re-runs,
+    which is what lets the server decode ITS OWN encoding and adopt the
+    reconstruction as the canonical next model."""
+    from fedml_tpu.compress import (
+        BCAST_STREAM,
+        get_codec,
+        wire_encode_tree,
+    )
+
+    codec = get_codec(codec_name)
+    if codec is None:
+        return {
+            WIRETREE_KEY: 2, "codec": "none", "delta": True,
+            "leaves": [
+                np.ascontiguousarray(np.asarray(l, np.float32))
+                for l in jax.tree_util.tree_leaves(update_tree)
+            ],
+        }
+    k_round = jax.random.fold_in(jax.random.PRNGKey(seed), round_idx)
+    key = jax.random.fold_in(k_round, BCAST_STREAM)
+    return {
+        WIRETREE_KEY: 2, "codec": codec.name, "delta": True,
+        "leaves": wire_encode_tree(codec, update_tree, key),
+    }
+
+
+def reconstruct_sync_model(msg: Message, template, bases,
+                           window: int):
+    """THE shared receive-side half of the delta broadcast — used by
+    the per-process client AND the muxer's cohort manager, so the
+    reconstruction/caching steps (like the addition formula above)
+    cannot drift between topologies.
+
+    Full frames decode directly; delta frames apply the shipped chain
+    updates in order onto the cached base.  When the server announces
+    delta mode (``delta_window`` param), the round's model is cached in
+    ``bases`` (ordered round -> model, evicted beyond window+1) as
+    OWNED arrays: the full-frame decode yields views into a transport
+    buffer (an shm slab region is reclaimed when delivery ends), so
+    that path copies; the delta path's ``apply_bcast_delta`` output is
+    freshly allocated already and is cached as-is.
+
+    Returns ``(variables, window)`` — ``variables`` is None when the
+    delta's base is not cached (the caller requests a resync), and
+    ``window`` echoes the announced cache depth (or the one passed
+    in)."""
+    base_round = msg.get(MSG_ARG_KEY_DELTA_BASE)
+    round_idx = msg.get(MSG_ARG_KEY_ROUND_INDEX)
+    payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+    if base_round is None:
+        variables = tree_from_wire(payload, template)
+        aliased = True  # np views into the frame's transport buffer
+    else:
+        base = bases.get(base_round)
+        if base is None:
+            return None, window
+        variables = base
+        for wire in payload or []:
+            variables = apply_bcast_delta(
+                variables, tree_from_wire(wire, template)
+            )
+        # fp32 add + cast allocated fresh arrays (an empty delta list
+        # degenerates to the cached — already owned — base itself)
+        aliased = False
+    announced = msg.get("delta_window")
+    if announced is not None:
+        window = max(int(announced), 1)
+        if aliased:
+            variables = jax.tree_util.tree_map(
+                lambda l: np.array(l, copy=True), variables
+            )
+        bases[round_idx] = variables
+        while len(bases) > window + 1:
+            bases.popitem(last=False)
+    return variables, window
+
+
+def request_resync(send, node_id: int, round_idx) -> None:
+    """THE shared resync request (the send-side twin of
+    ``reconstruct_sync_model``'s None return): one C2S_RESYNC frame
+    echoing the round whose delta base was missing.  Per-process
+    clients and the muxer's cohort walkback both build the request
+    HERE, so a protocol change (an extra param, a renamed key) cannot
+    desynchronize the two topologies' recovery paths."""
+    resync = Message(MSG_TYPE_C2S_RESYNC, node_id, SERVER)
+    resync.add_params(MSG_ARG_KEY_ROUND_INDEX, round_idx)
+    send(resync)
+
+
+def bcast_wire_nbytes(wire: dict) -> int:
+    """Encoded payload bytes of one broadcast wire (codec enc arrays,
+    or raw leaves for the ``none`` arm) — what
+    ``comm.delta_bcast_bytes`` counts."""
+    total = 0
+    for leaf in wire.get("leaves") or []:
+        if isinstance(leaf, dict) and "enc" in leaf:
+            total += sum(int(np.asarray(v).nbytes)
+                         for v in leaf["enc"].values())
+        else:
+            total += int(np.asarray(leaf).nbytes)
+    return total
+
+
 class FedAvgServerManager(NodeManager):
     """Rank-0 coordinator: sample → broadcast → collect → aggregate.
 
@@ -176,6 +308,8 @@ class FedAvgServerManager(NodeManager):
     # holds= contracts on the mutating methods cover them.
     _GUARDED_BY = {
         "pending": "_round_lock",
+        "_acked": "_ack_lock",
+        "_delta_log": "_ack_lock",
         "_agg_acc": "_round_lock",
         "_agg_n": "_round_lock",
         "_conn_acc": "_round_lock",
@@ -210,6 +344,9 @@ class FedAvgServerManager(NodeManager):
         status_dir: Optional[str] = None,
         stats_interval: float = 1.0,
         defense=None,
+        bcast: str = "full",
+        bcast_codec: str = "",
+        delta_base_window: int = 4,
     ):
         from fedml_tpu.compress import get_codec
 
@@ -280,6 +417,58 @@ class FedAvgServerManager(NodeManager):
                 "conn_cap requires the streaming hot path "
                 "(streaming_agg=True / --hotpath fast)"
             )
+        # delta/dedup broadcast (``--bcast delta``): consecutive rounds'
+        # models differ by exactly one aggregated update, so the sync
+        # ships the int8-encoded UPDATE against each connection's
+        # last-acked round instead of the full model.  The server
+        # maintains the broadcast model as a QUANTIZED CHAIN: at every
+        # close it encodes the aggregate update with ``bcast_codec``
+        # (default qsgd8 in delta mode), decodes its own encoding, and
+        # adopts base + decode as the canonical next model — the
+        # quantization error rides an EF residual into the next round's
+        # update (PR-4 recurrence, downlink edition).  Every receiver
+        # applying the same decoded deltas in the same order holds the
+        # server's model BIT-FOR-BIT, and a ``--bcast full`` run at the
+        # same chain codec broadcasts the identical model sequence —
+        # the delta-vs-full byte-identity pin.  ``--bcast full`` with
+        # no explicit chain codec is the exact legacy path.
+        if bcast not in ("full", "delta"):
+            raise ValueError(f"unknown bcast mode {bcast!r} (full|delta)")
+        if bcast == "delta" and not self.multicast:
+            # delta envelopes are shared multicast frames (receivers
+            # derive identity from their node id); the legacy per-node
+            # unicast arm has no delta form — refuse, don't run full
+            raise ValueError(
+                "bcast='delta' requires the multicast hot path "
+                "(--hotpath fast)"
+            )
+        self.bcast = bcast
+        self.bcast_codec_name = (
+            bcast_codec or ("qsgd8" if bcast == "delta" else "none")
+        )
+        # chain quantization is a property of the CLOSE, independent of
+        # the wire form: on whenever delta mode is on, or when a chain
+        # codec was explicitly requested for a full-broadcast arm (the
+        # digest-pin comparison arm)
+        self._chain = (bcast == "delta"
+                       or self.bcast_codec_name not in ("", "none"))
+        self.delta_base_window = max(1, int(delta_base_window))
+        self._chain_resid = None  # downlink EF residual (fp32 tree)
+        # node id -> last round whose sync the node provably received
+        # (it echoed the round index on an upload); the delta log maps
+        # round r -> the encoded update taking M_{r-1} to M_r, bounded
+        # to the last ``delta_base_window`` rounds
+        from collections import OrderedDict
+
+        self._acked: Dict[int, int] = {}
+        self._delta_log: "OrderedDict[int, dict]" = OrderedDict()
+        # ((round_idx, id(variables)), wire): the current model encoded
+        # at most once per round however many full sends need it
+        self._full_wire_cache = None
+        # reader threads update acks while the (possibly off-thread)
+        # broadcast groups by them: leaf lock, ordered round_lock ->
+        # _ack_lock at every site that holds both
+        self._ack_lock = make_lock("FedAvgServerManager._ack_lock")
         self._agg_acc = None
         self._agg_n = 0.0
         # per-connection num/den accumulators (conn caps only):
@@ -392,6 +581,12 @@ class FedAvgServerManager(NodeManager):
         # digest frames quietly, not spam unhandled-frame warnings
         self.register_message_receive_handler(
             MSG_TYPE_C2S_TELEMETRY, self._on_telemetry
+        )
+        # delta-broadcast recovery: a rejoining client asking for a
+        # full-model resend (registered unconditionally for the same
+        # quiet-interop reason)
+        self.register_message_receive_handler(
+            MSG_TYPE_C2S_RESYNC, self._on_resync
         )
 
     # -- stats plane --------------------------------------------------------
@@ -588,7 +783,10 @@ class FedAvgServerManager(NodeManager):
             req = getattr(self.backend, "request_conn_map", None)
             if req is not None:
                 req()
-        wire = tree_to_wire(self.variables)  # encode once per round
+        if self.bcast == "delta":
+            self._broadcast_delta(msg_type, nodes)
+            return
+        wire = self._full_wire()  # encode once per (round, model)
         if not self.multicast:
             for node in nodes:
                 self._send_or_log(
@@ -607,6 +805,115 @@ class FedAvgServerManager(NodeManager):
                 "rely on the round deadline)", self.round_idx, msg_type,
                 nodes,
             )
+
+    def _broadcast_delta(self, msg_type: str, nodes) -> None:
+        """Delta-mode fan-out: group the cohort by last-acked round and
+        ship each group the encoded chain updates it is missing (acked
+        b ⇒ deltas b+1..r, applied in order — the telescoped sum IS the
+        current model, bit-for-bit).  Nodes with no ack, or whose base
+        aged past the window, get the full model — counted, the
+        rejoin/behind fallback the protocol promises."""
+        r = self.round_idx
+        with self._ack_lock:
+            acked = dict(self._acked)
+            log = dict(self._delta_log)
+        tel = get_telemetry()
+        groups: Dict[int, list] = {}
+        full_nodes: list = []
+        for node in nodes:
+            b = acked.get(node)
+            if b is None or b >= r:
+                # no ack yet (cold start / post-resync) — or an ack at
+                # or past this round (an empty-delta no-op we ship as
+                # full for simplicity; only reachable in teardown races)
+                if b is None:
+                    tel.inc("comm.delta_full_fallbacks", reason="no_ack")
+                full_nodes.append(node)
+            elif all(k in log for k in range(b + 1, r + 1)):
+                groups.setdefault(b, []).append(node)
+            else:
+                # the base aged out of the bounded delta log: stale-base
+                # eviction forces a full resend
+                tel.inc("comm.delta_full_fallbacks", reason="window")
+                full_nodes.append(node)
+        for b in sorted(groups):
+            wires = [log[k] for k in range(b + 1, r + 1)]
+            # the shared multicast sync envelope (_model_msg) with the
+            # params swapped for the chain wires + their base round —
+            # one envelope builder, delta and full frames cannot drift
+            msg = self._model_msg(msg_type, None, None, wires)
+            msg.add_params(MSG_ARG_KEY_DELTA_BASE, b)
+            tel.inc("comm.delta_bcast_bytes",
+                    sum(bcast_wire_nbytes(w) for w in wires))
+            self._mcast_or_log(msg, groups[b], msg_type)
+        if full_nodes:
+            msg = self._model_msg(msg_type, None, None, self._full_wire())
+            self._mcast_or_log(msg, full_nodes, msg_type)
+
+    def _mcast_or_log(self, msg: Message, receivers, msg_type: str) -> None:
+        """One multicast with the broadcast path's failure contract: a
+        transport error after the backend's bounded retries makes these
+        receivers deadline stragglers (or fails fast with no deadline)."""
+        try:
+            self.backend.send_multicast(msg, receivers)
+        except OSError:
+            if self.round_timeout is None:
+                raise
+            get_telemetry().inc("comm.send_failed", msg_type=msg_type)
+            logging.warning(
+                "round %d: could not deliver %s multicast to %s (will "
+                "rely on the round deadline)", self.round_idx, msg_type,
+                receivers,
+            )
+
+    def _full_wire(self):
+        """The current model's wire form, encoded at most once per
+        (round, model) pair — shared by the full-broadcast path, the
+        delta mode's full-fallback group, and every resync unicast in
+        the round (a rejoined muxer's whole cohort resyncs at once;
+        without the memo that is O(cohort x model) encode work under
+        the round lock).  Callable with OR without ``_round_lock``
+        (the encode thread broadcasts unlocked): the key re-derives
+        per call and the cache swap is one atomic tuple assignment, so
+        a racing caller at worst duplicates one encode — never serves
+        a stale wire for a different (round, model)."""
+        key = (self.round_idx, id(self.variables))
+        cached = self._full_wire_cache
+        if cached is None or cached[0] != key:
+            cached = (key, tree_to_wire(self.variables))
+            self._full_wire_cache = cached
+        return cached[1]
+
+    def _advance_chain(self, prev_model) -> None:  # fedlint: holds=_round_lock
+        """Close-time half of the delta broadcast (caller holds the
+        round lock): U = aggregate − M_r + residual, encoded on the
+        seeded broadcast stream; M_{r+1} := M_r + decode(encode(U));
+        residual := U − decode(encode(U)).  The encoded wire lands in
+        the bounded delta log under round r+1 (the sync that ships it
+        first)."""
+        assert_held(self._round_lock, "FedAvgServerManager._advance_chain")
+        next_round = self.round_idx + 1
+        raw = jax.tree_util.tree_map(
+            lambda a, b: np.asarray(a, np.float32) - np.asarray(b, np.float32),
+            self.variables, prev_model,
+        )
+        if self._chain_resid is not None:
+            raw = jax.tree_util.tree_map(
+                lambda u, r: u + r, raw, self._chain_resid
+            )
+        wire = encode_bcast_delta(
+            self.bcast_codec_name, raw, seed=self.seed,
+            round_idx=next_round,
+        )
+        decoded = tree_from_wire(wire, self.variables)
+        self._chain_resid = jax.tree_util.tree_map(
+            lambda u, d: u - np.asarray(d, np.float32), raw, decoded
+        )
+        self.variables = apply_bcast_delta(prev_model, decoded)
+        with self._ack_lock:
+            self._delta_log[next_round] = wire
+            while len(self._delta_log) > self.delta_base_window:
+                self._delta_log.popitem(last=False)
 
     def _arm_deadline(self):
         if self.round_timeout is None:
@@ -673,6 +980,11 @@ class FedAvgServerManager(NodeManager):
             m.add_params(MSG_ARG_KEY_CODEC, self.codec_name)
         if self.steps_per_epoch is not None:
             m.add_params("steps_per_epoch", self.steps_per_epoch)
+        if self.bcast == "delta":
+            # full frames in delta mode still announce the cache depth:
+            # the receiver starts (or keeps) caching reconstructed
+            # rounds so later deltas have their base on hand
+            m.add_params("delta_window", self.delta_base_window)
         return m
 
     def _is_stale(self, msg: Message, reply_round) -> bool:  # fedlint: holds=_round_lock
@@ -693,9 +1005,49 @@ class FedAvgServerManager(NodeManager):
             return True
         return False
 
+    def _on_resync(self, msg: Message) -> None:
+        """A client received a delta against a base it no longer holds
+        (fresh process, rejoined muxer, cache aged out): clear its ack
+        so the NEXT broadcast goes full, and unicast the current
+        round's full model right away so it can still make this round.
+        The wire is the memoized per-round encode (``_full_wire``) —
+        a rejoined muxer's whole cohort resyncs at once, and per-node
+        encodes under the round lock would serialize O(cohort x model)
+        work in front of upload folding."""
+        with self._round_lock:
+            with self._ack_lock:
+                self._acked.pop(msg.sender, None)
+            if self.bcast != "delta" or self.round_idx >= self.comm_rounds:
+                return
+            get_telemetry().inc("comm.delta_full_fallbacks",
+                                reason="resync")
+            m = self._model_msg(
+                MSG_TYPE_S2C_SYNC_MODEL, msg.sender, msg.sender - 1,
+                self._full_wire(),
+            )
+        # send OUTSIDE the round lock (every other model send does):
+        # the socket write plus its bounded retry backoffs would
+        # otherwise stall upload folding and the deadline timer for
+        # the whole cohort's resync walkback.  If the round closes
+        # between build and send the client just gets last round's
+        # sync — its upload is stale-rejected and the cleared ack
+        # makes the next broadcast full, same as any in-flight sync
+        # racing a close.
+        self._send_or_log(m)
+
     def _on_model(self, msg: Message):
         reply_round = msg.get(MSG_ARG_KEY_ROUND_INDEX)
         with self._round_lock:
+            if self.bcast == "delta" and reply_round is not None:
+                # implicit ack: an upload echoing round r proves the
+                # node RECEIVED round r's sync, i.e. holds the chain
+                # model M_r — even a stale or later-rejected upload
+                # proves that much (a lying ack only costs the liar a
+                # resync round trip)
+                with self._ack_lock:
+                    prev = self._acked.get(msg.sender)
+                    if prev is None or int(reply_round) > prev:
+                        self._acked[msg.sender] = int(reply_round)
             if self._is_stale(msg, reply_round):
                 return
             # delta uploads reconstruct against the model THIS round
@@ -706,13 +1058,23 @@ class FedAvgServerManager(NodeManager):
         if self._decode_pool is not None:
             # pipeline: hand decode+fold to the worker pool and free
             # the reader thread for the next frame — decode of upload i
-            # overlaps the wire receive of upload i+1
+            # overlaps the wire receive of upload i+1.  A slab-backed
+            # payload (shm lane) is pinned across the thread handoff so
+            # the ring cannot reclaim it before the decode ran.
+            unpin = msg.pin_payload()
             self._decode_pool.submit(
-                self._decode_and_fold, msg, base, reply_round,
-                time.perf_counter(),
+                self._decode_and_fold_pinned, msg, base, reply_round,
+                time.perf_counter(), unpin,
             )
             return
         self._decode_and_fold(msg, base, reply_round, None)
+
+    def _decode_and_fold_pinned(self, msg, base, reply_round, t_submit,
+                                unpin) -> None:
+        try:
+            self._decode_and_fold(msg, base, reply_round, t_submit)
+        finally:
+            unpin()
 
     def _decode_and_fold(self, msg: Message, base, reply_round,
                          t_submit: Optional[float]) -> None:
@@ -853,7 +1215,13 @@ class FedAvgServerManager(NodeManager):
             else:
                 # buffered: the legacy baseline arm, or a robust
                 # estimator (median/trimmed-mean) that needs all K
-                # decoded trees at close
+                # decoded trees at close.  A slab-backed upload's
+                # decoded views would outlive its pin (the close can be
+                # a whole deadline away) — own the bytes here.
+                if msg._region is not None:
+                    variables = jax.tree_util.tree_map(
+                        lambda l: np.array(l, copy=True), variables
+                    )
                 meta["variables"] = variables
             self.pending[msg.sender] = meta
             if len(self.pending) < self.clients_per_round:
@@ -892,6 +1260,10 @@ class FedAvgServerManager(NodeManager):
         assert_held(self._round_lock, "FedAvgServerManager._close_round")
         if self._deadline_timer is not None:
             self._deadline_timer.cancel()
+        # the model this round BROADCAST (M_r): the chain advance below
+        # and the delta log are defined against it — capture before the
+        # aggregation overwrites self.variables
+        prev_model = self.variables
         sampled = set(self._sampled_nodes())
         time_agg = 0.0
         capped_conns = 0
@@ -960,6 +1332,16 @@ class FedAvgServerManager(NodeManager):
             # same span series the simulation drivers feed (obs layer):
             # the reference's FedAVGAggregator.py:59,85-86 aggregate timer
             get_telemetry().observe("span.agg_s", time_agg)
+        if self._chain:
+            # quantized-chain advance (delta mode, and the full-mode
+            # digest-pin arm at an explicit chain codec): encode the
+            # aggregate update (+ the EF residual), decode OUR OWN
+            # encoding, and adopt base + decode as the canonical next
+            # model — every receiver of the delta reconstructs exactly
+            # this, and the quantization error is carried, not lost.
+            # On a dropped_all round the update is just the pending
+            # residual (the chain still advances deterministically).
+            self._advance_chain(prev_model)
         # wall-clock close stamp: deltas between consecutive recs are
         # the per-round wall time a federation artifact reports; the
         # monotonic open/close pair shares the hop-stamp clock
@@ -1184,6 +1566,17 @@ class FedAvgClientManager(NodeManager):
         # into the next update — on by default for lossy codecs
         self.error_feedback = error_feedback
         self._ef = {}  # ef_for store; one entry (this client's stream)
+        # delta-broadcast base cache: round -> OWNED copy of the
+        # reconstructed chain model (populated only when the server
+        # announces delta mode via the sync's delta_window param).
+        # Owned copies serve two contracts at once: any in-window delta
+        # base is on hand across rounds, and nothing cached can alias a
+        # transport buffer (an shm slab region is reclaimed the moment
+        # delivery ends)
+        from collections import OrderedDict
+
+        self._bases: "OrderedDict[int, object]" = OrderedDict()
+        self._base_window = 4
         # sha256 over every encoded upload's payload buffers, in send
         # order — the reproducibility probe a federation re-run compares
         # (same seed => identical digest)
@@ -1222,7 +1615,9 @@ class FedAvgClientManager(NodeManager):
             import time
 
             time.sleep(self.train_delay)
-        variables = tree_from_wire(msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.template)
+        variables = self._reconstruct_sync(msg)
+        if variables is None:
+            return  # inapplicable delta: resync requested, round skipped
         client_idx = msg.get(MSG_ARG_KEY_CLIENT_INDEX)
         if client_idx is None:
             # multicast sync: ONE shared envelope for the whole cohort —
@@ -1264,6 +1659,29 @@ class FedAvgClientManager(NodeManager):
             MSG_ARG_KEY_LOCAL_METRICS, {k: float(v) for k, v in metrics.items()}
         )
         self.send_message(reply)
+
+    def _reconstruct_sync(self, msg: Message):
+        """One sync envelope → this round's model, via the SHARED
+        ``reconstruct_sync_model`` (the muxer uses the same function —
+        reconstruction cannot drift between topologies).  A delta whose
+        base is not cached (fresh process, aged-out round) triggers a
+        RESYNC request and returns None: this round is skipped and the
+        server's unicast full resend (or the next full fallback)
+        re-seeds the cache."""
+        variables, self._base_window = reconstruct_sync_model(
+            msg, self.template, self._bases, self._base_window
+        )
+        if variables is None:
+            get_telemetry().inc("comm.delta_resyncs")
+            logging.warning(
+                "node %d: delta sync for round %s against unknown "
+                "base %s — requesting full resync",
+                self.backend.node_id, msg.get(MSG_ARG_KEY_ROUND_INDEX),
+                msg.get(MSG_ARG_KEY_DELTA_BASE),
+            )
+            request_resync(self.send_message, self.backend.node_id,
+                           msg.get(MSG_ARG_KEY_ROUND_INDEX))
+        return variables
 
     def _encode_upload(self, codec_name: str, new_vars, synced_vars,
                        round_idx: int, slot: int):

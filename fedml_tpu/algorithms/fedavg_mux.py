@@ -60,13 +60,14 @@ from fedml_tpu.comm.message import (
     MSG_TYPE_S2C_INIT_CONFIG,
     MSG_TYPE_S2C_SYNC_MODEL,
     Message,
-    tree_from_wire,
 )
 from fedml_tpu.algorithms.fedavg_cross_device import (
     MSG_ARG_KEY_CODEC,
     SERVER,
     encode_client_upload,
     ef_for,
+    reconstruct_sync_model,
+    request_resync,
 )
 from fedml_tpu.comm.mux import TcpMuxBackend
 from fedml_tpu.core.client import LocalUpdateFn
@@ -124,6 +125,7 @@ class FedAvgMuxClientManager:
     # (pack cache, EF stores, digests) is serialized by _train_lock
     _GUARDED_BY = {
         "_pending": "_plock",
+        "_bases": "_train_lock",
         "_pack_key": "_train_lock",
         "_pack_ids": "_train_lock",
         "_pack_index": "_train_lock",
@@ -144,8 +146,22 @@ class FedAvgMuxClientManager:
         train_delay: float = 0.0,
         crash_at_round: Optional[int] = None,
         wrap_backend: Optional[Callable[[CommBackend], CommBackend]] = None,
+        rejoin_every_round: bool = False,
     ):
         self.mux = mux
+        # connection-churn soak knob: after every trained round this
+        # muxer drops its hub connection (auto_reconnect re-dials and
+        # re-helloes — the hub's rebind counters grow) AND forgets its
+        # delta base cache, so the next delta broadcast finds a
+        # rejoiner that must be walked back to a full model (resync).
+        # Production muxers never set this; tools/fed_scale_run.py's
+        # churn mode is its only caller.
+        self.rejoin_every_round = bool(rejoin_every_round)
+        # once-per-round guard for the churn rebind: a round's resync
+        # walkback delivers per-node unicast fulls (one flush each),
+        # and rebinding on every one of those would orphan the rest of
+        # the walkback in the displaced connection's queues
+        self._last_rebind_round = -1
         self.dataset = dataset
         self.batch_size = batch_size
         self.template = template_variables
@@ -190,6 +206,15 @@ class FedAvgMuxClientManager:
         self._pack_index = None     # client id -> row
         self._pack_host = None      # (x, y, mask, num_samples) numpy
         self._pack_dev = None       # full-cohort jnp arrays (fast path)
+        # delta-broadcast base cache, shared by the whole co-located
+        # cohort (chain models are globally identical): round -> OWNED
+        # copy of the reconstructed model — same two contracts as the
+        # per-process client's cache (in-window bases on hand; nothing
+        # cached aliases a transport buffer)
+        from collections import OrderedDict
+
+        self._bases: "OrderedDict[int, object]" = OrderedDict()
+        self._base_window = 4
         self._ef: Dict[int, object] = {}
         self._hash = {n: hashlib.sha256() for n in mux.node_ids}
         self.rounds_trained = {n: 0 for n in mux.node_ids}
@@ -241,6 +266,30 @@ class FedAvgMuxClientManager:
             pending, self._pending = self._pending, []
         if not pending:
             return
+        batch_round = max(
+            (m.get(MSG_ARG_KEY_ROUND_INDEX) for _, m in pending
+             if m.get(MSG_ARG_KEY_ROUND_INDEX) is not None),
+            default=None,
+        )
+        if (self.rejoin_every_round and not self._finished.is_set()
+                and batch_round is not None
+                and batch_round > self._last_rebind_round):
+            # churn soak, step 1: ONCE per round, re-hello on a FRESH
+            # connection while the old one is still registered — the
+            # hub counts one rebind per virtual id, and doing it BEFORE
+            # training means the connection is stable again by the time
+            # this round's uploads (and the server's next broadcast or
+            # resync walkback) happen, so the soak churns identities,
+            # not round deadlines
+            self._last_rebind_round = batch_round
+            try:
+                self.mux.rebind_connection()
+            except (OSError, ConnectionError):
+                logging.exception(
+                    "muxer %d: churn re-dial failed; falling back to "
+                    "drop_connection", self.mux.node_id,
+                )
+                self.mux.drop_connection()
         if self.crash_at_round is not None and any(
             m.get(MSG_ARG_KEY_ROUND_INDEX) == self.crash_at_round
             for _, m in pending
@@ -265,10 +314,11 @@ class FedAvgMuxClientManager:
                 groups[key] = (msg, [])
                 order.append(key)
             groups[key][1].append((node, msg))
+        trained = False
         for key in order:
             ref_msg, entries = groups[key]
             try:
-                self._train_cohort(ref_msg, entries)
+                trained = self._train_cohort(ref_msg, entries) or trained
             except Exception:
                 # one cohort's failure (undecodable sync, engine bug)
                 # must not take down the other groups or the reader
@@ -278,13 +328,50 @@ class FedAvgMuxClientManager:
                     "muxer %d: cohort train failed for nodes %s",
                     self.mux.node_id, [n for n, _ in entries],
                 )
+        if trained and self.rejoin_every_round \
+                and not self._finished.is_set():
+            # churn soak, step 2: this round's uploads are out — forget
+            # the delta bases (fresh-process amnesia), so the next
+            # delta broadcast finds a cold rejoiner and must walk it
+            # back through the resync/full-model path
+            self._bases.clear()
 
-    def _train_cohort(self, ref_msg: Message, entries: List[tuple]) -> None:  # fedlint: holds=_train_lock
-        entries = sorted(entries, key=lambda e: e[0])
-        variables = tree_from_wire(
-            ref_msg.get(MSG_ARG_KEY_MODEL_PARAMS), self.template
+    def _reconstruct_sync(self, ref_msg: Message):  # fedlint: holds=_train_lock
+        """The muxer side of the SHARED ``reconstruct_sync_model``
+        (``fedavg_cross_device``): one cache for the whole co-located
+        cohort (chain models are globally identical).  Returns None on
+        a missing base — the caller then requests a resync for every
+        virtual node in the cohort, since a rejoined muxer's whole
+        cohort is behind at once."""
+        variables, self._base_window = reconstruct_sync_model(
+            ref_msg, self.template, self._bases, self._base_window
         )
+        return variables
+
+    def _train_cohort(self, ref_msg: Message, entries: List[tuple]) -> bool:  # fedlint: holds=_train_lock
+        entries = sorted(entries, key=lambda e: e[0])
         round_idx = ref_msg.get(MSG_ARG_KEY_ROUND_INDEX)
+        variables = self._reconstruct_sync(ref_msg)
+        if variables is None:
+            from fedml_tpu.obs.telemetry import get_telemetry
+
+            get_telemetry().inc("comm.delta_resyncs",
+                                len(entries))
+            logging.warning(
+                "muxer %d: delta sync for round %s against unknown base "
+                "— requesting full resync for %d virtual nodes",
+                self.mux.node_id, round_idx, len(entries),
+            )
+            for node, _msg in entries:
+                try:
+                    request_resync(self._endpoints[node].send_message,
+                                   node, round_idx)
+                except OSError:
+                    logging.warning(
+                        "muxer %d: resync for virtual node %d lost",
+                        self.mux.node_id, node,
+                    )
+            return False
         codec_name = ref_msg.get(MSG_ARG_KEY_CODEC) or "none"
         steps = ref_msg.get("steps_per_epoch")
         # exactly the single-client identity derivation
@@ -364,6 +451,7 @@ class FedAvgMuxClientManager:
                          float(num_samples[k]),
                          {m: float(v[k]) for m, v in host_metrics.items()})
             self.rounds_trained[node] += 1
+        return True
 
     def _upload(self, node: int, msg: Message, new_vars, synced_vars,
                 round_idx, codec_name: str, slot: int, n_samples: float,

@@ -37,6 +37,7 @@ RESERVED_KEYS: Dict[str, Tuple[str, str]] = {
     "__ndarray__": ("NDARRAY_KEY", "fedml_tpu/comm/message.py"),
     "__trace__": ("TRACE_KEY", "fedml_tpu/obs/trace_ctx.py"),
     "__digest__": ("DIGEST_KEY", "fedml_tpu/obs/digest.py"),
+    "__shmseq__": ("SHM_SEQ_KEY", "fedml_tpu/comm/message.py"),
 }
 
 

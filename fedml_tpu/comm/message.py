@@ -51,6 +51,12 @@ MCAST_STRIPE_KIND = "mcast_stripe"
 # (comm/mux.py) — the shared payload crosses the wire once per
 # CONNECTION, never once per virtual node.
 MUX_KIND = "mux"
+# shared-memory lane doorbell (comm/shm.py): a frame header carrying
+# this key announces that its ``__binlen__`` payload bytes live in the
+# connection's shm slab at this descriptor sequence number instead of
+# following on the socket — the header (and frame ORDER) stays on TCP,
+# only the payload bytes move through the ring
+SHM_SEQ_KEY = "__shmseq__"
 FRAME_BINLEN_KEY = "__binlen__"  # header: raw payload bytes that follow
 FRAME_NDBUF_KEY = "__ndbuf__"  # header entry: [offset, nbytes] buffer ref
 WIRETREE_KEY = "__wiretree__"  # wire pytree envelope (version tag)
@@ -79,6 +85,14 @@ MSG_TYPE_S2C_FINISH = "S2C_FINISH"
 # (observability loss must be injected explicitly, never as a side
 # effect of a model-frame fault mix)
 MSG_TYPE_C2S_TELEMETRY = "C2S_TELEMETRY"
+# delta-broadcast resync (fedavg_cross_device): a client that received
+# a delta sync against a base round it no longer caches (fresh process,
+# rejoined muxer) asks the server for a full-model resend; the server
+# clears the node's ack and unicasts the current round's full sync
+MSG_TYPE_C2S_RESYNC = "C2S_RESYNC"
+# sync-envelope param naming the base round a delta broadcast applies
+# to (the receiver reconstructs base + the shipped per-round deltas)
+MSG_ARG_KEY_DELTA_BASE = "delta_base"
 # split-learning extras (reference split_nn/message_define.py:6-16)
 MSG_TYPE_C2S_SEND_ACTS = "C2S_SEND_ACTS"
 MSG_TYPE_S2C_SEND_GRADS = "S2C_SEND_GRADS"
@@ -86,6 +100,14 @@ MSG_TYPE_C2C_SEMAPHORE = "C2C_SEMAPHORE"
 
 
 class Message:
+    # payload residency (shm lane): when a frame's binary payload was
+    # mapped out of a shared-memory slab, the receiving backend attaches
+    # the refcounted region here so consumers that hand the message to
+    # ANOTHER thread (decode pools, chaos delay timers) can pin the
+    # bytes past the delivery scope — see ``pin_payload``.  None (the
+    # class default) = payload owns its memory, pinning is a no-op.
+    _region = None
+
     def __init__(self, msg_type: str = "", sender: int = 0, receiver: int = 0):
         self.params: Dict[str, Any] = {
             MSG_ARG_KEY_TYPE: msg_type,
@@ -171,7 +193,21 @@ class Message:
         m = Message()
         m.params = dict(self.params)
         m.params[MSG_ARG_KEY_RECEIVER] = receiver
+        # clones share the payload objects, so they share its residency:
+        # a pinned clone must keep the SAME slab region alive
+        m._region = self._region
         return m
+
+    def pin_payload(self):
+        """Keep a slab-resident payload alive past the delivery scope:
+        returns a release callable the consumer MUST invoke when done
+        (a no-op callable for ordinary heap-backed payloads).  Callers
+        that defer work to another thread pin BEFORE scheduling."""
+        region = self._region
+        if region is None:
+            return lambda: None
+        region.retain()
+        return region.release
 
     @classmethod
     def from_frame(cls, header_obj: dict, payload: bytes = b"") -> "Message":
@@ -189,10 +225,15 @@ class Message:
         line or a payload shorter than its ``__binlen__`` announcement
         (a reassembly that lost bytes must surface as a dropped logical
         frame, never a half-decoded model)."""
-        nl = data.find(b"\n")
-        if nl < 0:
+        from fedml_tpu.comm.shm import split_frame_line
+
+        end = split_frame_line(data)  # bytes OR slab memoryview
+        if end < 0:
             raise ValueError("frame has no header line")
-        header = json.loads(data[:nl + 1])
+        nl = end - 1
+        header = json.loads(bytes(data[:nl + 1])
+                            if isinstance(data, memoryview)
+                            else data[:nl + 1])
         # memoryview slices: the multi-MB payload is never copied —
         # decoded arrays are read-only views into ``data`` (exactly the
         # stream-reader path's buffer-sharing contract)

@@ -32,7 +32,6 @@ jit step lives in ``fedml_tpu.algorithms.fedavg_mux``.
 
 from __future__ import annotations
 
-import json
 import logging
 import threading
 from typing import Callable, Dict, List, Optional
@@ -124,8 +123,8 @@ class TcpMuxBackend(TcpBackend):
             self._virtual[i] = VirtualNodeBackend(self, i)
 
     # -- registration -------------------------------------------------------
-    def _hello_line(self) -> bytes:
-        return (json.dumps({"node_ids": self.node_ids}) + "\n").encode()
+    def _hello_obj(self) -> dict:
+        return {"node_ids": self.node_ids}
 
     # -- virtual endpoints --------------------------------------------------
     def virtual(self, node_id: int) -> VirtualNodeBackend:
@@ -184,8 +183,8 @@ class TcpMuxBackend(TcpBackend):
             self._dispatch_flag.active = False
         self._run_flush_hooks()
 
-    def _on_mux_frame(self, frame: dict, payload: bytes,
-                      nbytes: int) -> None:
+    def _on_mux_frame(self, frame: dict, payload, nbytes: int,
+                      region=None) -> None:
         try:
             msg = Message.from_frame_bytes(payload)
         except Exception:
@@ -194,6 +193,10 @@ class TcpMuxBackend(TcpBackend):
                 "copy dropped", self.node_id, frame.get("msg_type"),
             )
             return
+        # slab-backed payload: the clones the local fan-out hands each
+        # virtual node share this residency, so a chaos-delayed copy's
+        # timer path can pin the bytes past the dispatch scope
+        msg._region = region
         self._fan_out_local(msg, frame.get("nodes"), nbytes)
 
     def _deliver_reassembled(self, msg: Message, ent: dict) -> None:

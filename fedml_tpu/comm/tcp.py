@@ -44,7 +44,16 @@ from fedml_tpu.comm.message import (
     HUB_KEY,
     MCAST_STRIPE_KIND,
     MUX_KIND,
+    SHM_SEQ_KEY,
     Message,
+)
+from fedml_tpu.comm.shm import (
+    DEFAULT_DATA_BYTES,
+    DEFAULT_MIN_BYTES,
+    DEFAULT_SLOTS,
+    ShmLane,
+    ShmLaneError,
+    split_frame_line,
 )
 from fedml_tpu.obs import trace_ctx
 from fedml_tpu.obs.telemetry import get_telemetry
@@ -197,9 +206,10 @@ class _Conn:
     would drain head+tail together)."""
 
     __slots__ = ("sock", "frames", "heads", "nbytes", "scheduled",
-                 "ids", "mux", "cid", "dead")
+                 "ids", "mux", "cid", "dead", "lane")
 
-    def __init__(self, sock: socket.socket, ids=(), mux: bool = False):
+    def __init__(self, sock: socket.socket, ids=(), mux: bool = False,
+                 lane=None):
         self.sock = sock
         self.frames: deque = deque()  # (msg_type, parts, hdr, nbytes, rids)
         self.heads: deque = deque()  # same entries, strict priority
@@ -209,6 +219,11 @@ class _Conn:
         self.mux = mux
         self.cid = 0
         self.dead = False
+        # shared-memory lane (comm/shm.py), attached at hello when the
+        # dialer advertised a slab: large payload bytes in BOTH
+        # directions ride its rings while every header stays on this
+        # socket (order, control frames, and fallback are the stream's)
+        self.lane = lane
 
 
 class TcpHub:
@@ -235,12 +250,16 @@ class TcpHub:
         "striped_mcasts": "_lock",
         "stripe_frames": "_lock",
         "node_rebinds": "_lock",
+        "shm_frames": "_lock",
+        "shm_bytes": "_lock",
+        "shm_fallbacks": "_lock",
     }
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  senders: int = 4, max_queue_bytes: int = 256 << 20,
                  max_queue_frames: int = 4096,
-                 stripe_bytes: int = 0, max_inflight_stripes: int = 8):
+                 stripe_bytes: int = 0, max_inflight_stripes: int = 8,
+                 shm_min_bytes: int = DEFAULT_MIN_BYTES):
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()
         # striped fan-out: an mcast payload larger than ``stripe_bytes``
@@ -276,6 +295,16 @@ class TcpHub:
         # reconnect case (the old conn is half-dead) and a genuine
         # two-live-conns conflict (last dialer wins, visibly).
         self.node_rebinds = 0
+        # shared-memory lane accounting: frames/bytes the hub moved
+        # through slab rings (either direction) and payloads that fell
+        # back to inline TCP (ring full, descriptor queue full,
+        # oversized) — the fallback is per-frame and silent on the
+        # wire, so the counter is the only evidence it happened
+        self.shm_frames = 0
+        self.shm_bytes = 0
+        self.shm_fallbacks = 0
+        # payloads below this ride inline TCP (policy, not fallback)
+        self._shm_min = max(0, int(shm_min_bytes))
         self._max_queue_bytes = max_queue_bytes
         self._max_queue_frames = max_queue_frames
         # node id -> connection; MANY-TO-ONE since hello v2 (a muxer
@@ -308,6 +337,7 @@ class TcpHub:
         node_id = None
         ids: List[int] = []
         st = None
+        lane = None
         try:
             _tune_socket(conn)
             f = conn.makefile("rb")
@@ -327,6 +357,23 @@ class TcpHub:
                 ids = [int(hello_obj["node_id"])]
                 mux = False
             node_id = ids[0]  # primary id: peers replies, logging
+            # shm-lane capability (hello key "shm"): the dialer created
+            # a slab and advertises it; attach if we can reach it (the
+            # same-box test IS the attach — a cross-host name simply
+            # doesn't exist here) and confirm in the ACK.  Any failure
+            # downgrades the connection to pure TCP, never an error.
+            lane = None
+            shm_desc = hello_obj.get("shm")
+            if isinstance(shm_desc, dict):
+                try:
+                    lane = ShmLane.attach(shm_desc)
+                except Exception as e:
+                    logging.warning(
+                        "hub: shm attach for node %s failed (%s: %s) — "
+                        "connection stays pure TCP", node_id,
+                        type(e).__name__, e,
+                    )
+                    lane = None
             # ACK BEFORE registering: once registered, the sender pool
             # may write to this conn concurrently, and an ACK
             # interleaved with a routed frame would hand the dialing
@@ -334,8 +381,11 @@ class TcpHub:
             # the ack→register window is dropped — but nobody can have
             # observed this node as registered yet (await_peers reads
             # the registry), so that is the normal unregistered-
-            # receiver drop, not a race.
-            conn.sendall((json.dumps(_ACK) + "\n").encode())
+            # receiver drop, not a race.  Old dialers ignore the extra
+            # "shm" confirmation key.
+            conn.sendall((json.dumps(
+                {**_ACK, "shm": lane is not None}
+            ) + "\n").encode())
             # clock-sync phase: still UNREGISTERED (no sender worker can
             # touch this conn), so ping replies may be written directly
             # by this reader thread and are guaranteed to be the next
@@ -365,7 +415,7 @@ class TcpHub:
                 # pre-handshake peers (an old dialer): fall through to
                 # registration and let the main loop service this line
                 break
-            st = _Conn(conn, ids=ids, mux=mux)
+            st = _Conn(conn, ids=ids, mux=mux, lane=lane)
             rebound: List[int] = []
             stale_conns: List[_Conn] = []
             with self._lock:
@@ -429,10 +479,34 @@ class TcpHub:
                 # v2 binary frame: the header announces exactly how many
                 # raw payload bytes follow — read them here so routing
                 # forwards header+payload as ONE unit and the readline
-                # loop never parses payload bytes as lines
+                # loop never parses payload bytes as lines.  A header
+                # carrying the shm doorbell key maps the payload out of
+                # the connection's slab instead (one copy into hub
+                # memory — routing queues outlive this read scope); a
+                # torn descriptor is connection-fatal, exactly like a
+                # garbled header.
                 payload = b""
                 binlen = frame.get(FRAME_BINLEN_KEY)
-                if binlen:
+                sseq = frame.pop(SHM_SEQ_KEY, None)
+                if binlen and sseq is not None:
+                    if st.lane is None:
+                        logging.warning(
+                            "hub: node %s sent an shm doorbell on a "
+                            "lane-less connection — dropping it", node_id,
+                        )
+                        break
+                    try:
+                        payload = st.lane.read_copy(sseq, binlen)
+                    except ShmLaneError as e:
+                        logging.warning(
+                            "hub: shm lane error from node %s (%s) — "
+                            "dropping connection", node_id, e,
+                        )
+                        break
+                    with self._lock:
+                        self.shm_frames += 1
+                        self.shm_bytes += len(payload)
+                elif binlen:
                     payload = f.read(binlen)
                     if len(payload) < binlen:
                         break  # peer died mid-payload: torn frame == EOF
@@ -553,6 +627,15 @@ class TcpHub:
                                       hdr=frame,
                                       nbytes=len(line) + len(payload))
                     else:
+                        if sseq is not None:
+                            # the raw forward ships this header line:
+                            # re-encode it WITHOUT the doorbell key
+                            # (popped above) — the receiver must never
+                            # be told to read someone else's lane.
+                            # Lazy on purpose: the dominant laned
+                            # shapes (mcast/control) re-encode at drain
+                            # from the parsed dict and never pay this.
+                            line = (json.dumps(frame) + "\n").encode()
                         self._forward(receiver,
                                       (line, payload) if payload else (line,),
                                       msg_type=frame.get("msg_type"))
@@ -568,6 +651,18 @@ class TcpHub:
                     for nid in ids:
                         if self._conns.get(nid) is st:
                             self._conns.pop(nid, None)
+            if lane is not None:
+                # detach AND unlink: a gracefully-stopping dialer
+                # unlinks its own slab too (double unlink is a caught
+                # no-op), but a CRASHED dialer (os._exit) never will —
+                # without this, every peer crash leaks a segment in
+                # /dev/shm until reboot.  Mapped regions survive the
+                # unlink, so a reconnecting peer's fresh slab is
+                # unaffected.  To the peer this must look exactly like
+                # a dropped connection, and it does: doorbells stop,
+                # the socket closes, the reconnect path re-dials with
+                # a fresh slab.
+                lane.close(unlink=True)
             try:
                 conn.close()
             except OSError:
@@ -886,11 +981,9 @@ class TcpHub:
                             line = None
                             body = list(parts)
                         if kind == MUX_KIND:
-                            outer = (json.dumps({
-                                HUB_KEY: MUX_KIND, **meta,
-                                FRAME_BINLEN_KEY: sum(
-                                    len(p) for p in body),
-                            }) + "\n").encode()
+                            out_hdr = {HUB_KEY: MUX_KIND, **meta,
+                                       FRAME_BINLEN_KEY: sum(
+                                           len(p) for p in body)}
                         else:
                             out_hdr = {HUB_KEY: MCAST_STRIPE_KIND,
                                        **meta}
@@ -900,19 +993,38 @@ class TcpHub:
                                 out_hdr["crc"] = zlib.crc32(line)
                             out_hdr[FRAME_BINLEN_KEY] = sum(
                                 len(p) for p in body)
-                            outer = (json.dumps(out_hdr) + "\n").encode()
-                        _sendall_parts(st.sock, [outer, *body])
+                        self._conn_send(st, out_hdr, None, body, msg_type)
                     elif hdr is not None:
                         # traced frame: re-encode the (small) header
                         # line with THIS copy's hub_out stamp at drain
                         # time — hub_out - hub_in is this receiver's
                         # real queue wait; the payload tail stays the
                         # one shared immutable object
-                        _sendall_parts(
-                            st.sock, [trace_ctx.hub_out_line(hdr), *parts]
-                        )
+                        stamped = dict(hdr)
+                        trace_ctx.hub_stamp(stamped, "hub_out")
+                        self._conn_send(st, stamped, None, list(parts),
+                                        msg_type)
                     else:
-                        _sendall_parts(st.sock, parts)
+                        # untraced complete frame(s): split the header
+                        # line off the first part so the payload tail
+                        # is lane-eligible (a scan up to the first
+                        # newline, never a payload copy)
+                        first = parts[0]
+                        end = split_frame_line(first)
+                        if end <= 0 or end == len(first):
+                            # header-only first part (control frames,
+                            # the unicast-forward (line, payload) shape)
+                            body = [p for p in parts[1:] if len(p)]
+                            self._conn_send(st, None, first, body,
+                                            msg_type)
+                        else:
+                            # embedded header (whole-frame mcast copy):
+                            # the tail view shares the one payload object
+                            view = memoryview(first)
+                            body = [view[end:],
+                                    *(p for p in parts[1:] if len(p))]
+                            self._conn_send(st, None, bytes(view[:end]),
+                                            body, msg_type)
                 except OSError:
                     # dead receiver: count this frame + everything still
                     # queued, deregister (its reader thread finishes
@@ -944,6 +1056,43 @@ class TcpHub:
                     self._count_drop(nid, msg_type)
                     continue
 
+    def _conn_send(self, st: _Conn, hdr_dict, line, body, msg_type) -> None:
+        """Write one frame to a connection: header line on the socket,
+        payload either vectored behind it (TCP) or through the conn's
+        shm ring with a doorbell key in the header (lane).  Exactly one
+        of ``hdr_dict`` (still a dict — drain-built outer headers,
+        traced restamps) and ``line`` (already-encoded bytes) is set;
+        ``body`` holds the payload parts.  Lane refusal (ring full,
+        descriptor queue full, oversized) falls back to the inline
+        write, per frame, counted — never an error and never a stall.
+        OSErrors propagate to the caller's dead-receiver handling."""
+        lane = st.lane
+        nbody = sum(len(p) for p in body) if body else 0
+        if lane is not None and nbody >= self._shm_min and nbody:
+            pending = lane.try_send(body, nbody)
+            if pending is not None:
+                if hdr_dict is None:
+                    hdr_dict = json.loads(line)
+                out = (json.dumps(
+                    {**hdr_dict, SHM_SEQ_KEY: ShmLane.seq_of(pending)}
+                ) + "\n").encode()
+                # doorbell AFTER the payload is fully in the slab: a
+                # writer killed between the two leaves nothing
+                # deliverable (the descriptor is never announced)
+                _sendall_parts(st.sock, [out])
+                lane.commit(pending)
+                with self._lock:
+                    self.shm_frames += 1
+                    self.shm_bytes += nbody
+                return
+            with self._lock:
+                self.shm_fallbacks += 1
+            get_telemetry().inc("comm.shm_fallbacks",
+                                reason=lane.last_refusal)
+        if hdr_dict is not None:
+            line = (json.dumps(hdr_dict) + "\n").encode()
+        _sendall_parts(st.sock, [line, *body] if body else [line])
+
     def _count_drop(self, receiver: int, msg_type) -> None:
         mt = msg_type or HUB_KEY
         with self._lock:
@@ -962,6 +1111,9 @@ class TcpHub:
             "striped_mcasts": self.striped_mcasts,
             "stripe_frames": self.stripe_frames,
             "node_rebinds": self.node_rebinds,
+            "shm_frames": self.shm_frames,
+            "shm_bytes": self.shm_bytes,
+            "shm_fallbacks": self.shm_fallbacks,
         }
 
     def stats(self) -> dict:
@@ -972,7 +1124,12 @@ class TcpHub:
         with self._lock:
             snap = self._counters_snapshot()
             snap["nodes"] = len(self._conns)
-            snap["connections"] = len(set(map(id, self._conns.values())))
+            conns = set(map(id, self._conns.values()))
+            snap["connections"] = len(conns)
+            snap["shm_conns"] = len(
+                {id(c) for c in self._conns.values()
+                 if c.lane is not None}
+            )
         return snap
 
     def sample_telemetry(self, telemetry=None) -> dict:
@@ -994,9 +1151,12 @@ class TcpHub:
         with self._lock:
             depths = {}
             nodes_total = len(self._conns)
+            shm_conns = 0
             for st in set(self._conns.values()):
                 depths[st.cid] = (len(st.frames) + len(st.heads),
                                   st.nbytes, len(st.ids))
+                if st.lane is not None:
+                    shm_conns += 1
             snap = self._counters_snapshot()
         for cid, (nframes, nbytes, nids) in depths.items():
             t.gauge_set("hub.send_queue_frames", nframes, conn=cid)
@@ -1014,6 +1174,10 @@ class TcpHub:
         t.gauge_set("hub.mcast_frames_total", snap["mcast_frames"])
         t.gauge_set("hub.stripe_frames_total", snap["stripe_frames"])
         t.gauge_set("hub.node_rebinds_total", snap["node_rebinds"])
+        t.gauge_set("hub.shm_conns", shm_conns)
+        t.gauge_set("hub.shm_frames_total", snap["shm_frames"])
+        t.gauge_set("hub.shm_bytes_total", snap["shm_bytes"])
+        t.gauge_set("hub.shm_fallbacks_total", snap["shm_fallbacks"])
         t.event(
             "hub_stats", t_m=trace_ctx.now(),
             connections=sorted(depths),
@@ -1082,10 +1246,28 @@ class TcpBackend(CommBackend):
 
     def __init__(self, node_id: int, host: str, port: int,
                  timeout: float = 30.0, auto_reconnect: int = 0,
-                 send_retries: int = 3, wire: int = 2):
+                 send_retries: int = 3, wire: int = 2,
+                 lane: str = "tcp",
+                 shm_data_bytes: int = DEFAULT_DATA_BYTES,
+                 shm_slots: int = DEFAULT_SLOTS,
+                 shm_min_bytes: int = DEFAULT_MIN_BYTES):
         super().__init__(node_id)
         self._host, self._port, self._timeout = host, port, timeout
         self.auto_reconnect = auto_reconnect
+        # transport lane for payload bytes: "shm" creates a
+        # shared-memory slab per dial and advertises it in the hello —
+        # same-box hubs attach and both directions' payloads ride its
+        # rings (headers stay on TCP); anything else (cross-host peer,
+        # refused attach, full ring, oversized frame) falls back to
+        # inline TCP per frame, counted.  "tcp" (default) is bitwise
+        # the pre-lane transport.
+        if lane not in ("tcp", "shm"):
+            raise ValueError(f"unknown lane {lane!r} (tcp|shm)")
+        self._lane_mode = lane
+        self._shm_data = int(shm_data_bytes)
+        self._shm_slots = int(shm_slots)
+        self._shm_min = max(0, int(shm_min_bytes))
+        self._lane: Optional[ShmLane] = None
         # wire generation for OUTBOUND frames: 2 = binary v2 frames
         # (Message.to_frame), 1 = legacy JSON lines (b64 arrays) — the
         # baseline arm of the compression measurement and the interop
@@ -1097,6 +1279,11 @@ class TcpBackend(CommBackend):
         # about to re-dial.  0 = fail fast (the pre-fault behavior).
         self.send_retries = max(0, int(send_retries))
         self._stopped = threading.Event()
+        # the rebind path leaves its displaced socket/lane OPEN for the
+        # hub to close (closing it ourselves would race the hub's
+        # deferred registration of the new conn and turn the rebind
+        # into a plain re-register); reaped at the next rebind or stop
+        self._stale_conn = None  # (file, sock, lane) or None
         # serializes send_message against _dial's socket swap: without
         # it, a send between "socket connected" and "hello written"
         # lands BEFORE the registration line and the hub parses the
@@ -1124,20 +1311,47 @@ class TcpBackend(CommBackend):
         with self._reasm_lock:
             self._stripe_fault_hook = hook
 
-    def _hello_line(self) -> bytes:
-        """Registration line sent on dial.  v1: one ``node_id``.  The
-        muxed subclass overrides with the hello-v2 ``node_ids`` form
+    def _hello_obj(self) -> dict:
+        """Registration payload.  v1: one ``node_id``.  The muxed
+        subclass overrides with the hello-v2 ``node_ids`` form
         (``comm/mux.py``); the hub accepts both on one port."""
-        return (json.dumps({"node_id": self.node_id}) + "\n").encode()
+        return {"node_id": self.node_id}
 
-    def _dial(self):
+    def _hello_line(self, lane: Optional[ShmLane] = None) -> bytes:
+        obj = self._hello_obj()
+        if lane is not None:
+            # shm capability: advertise the freshly created slab; the
+            # hub attaches (same box) or ignores (cross-host / error)
+            # and confirms in its ACK
+            obj["shm"] = lane.describe()
+        return (json.dumps(obj) + "\n").encode()
+
+    def _dial(self, keep_stale: bool = False):
         with self._send_lock:
             sock = socket.create_connection(
                 (self._host, self._port), timeout=self._timeout
             )
             _tune_socket(sock)
+            # slab creation only AFTER the socket connected: the
+            # startup/reconnect paths retry _dial in a loop, and a
+            # pre-connect slab (2 rings, fully prefaulted) would leak
+            # ~shm_data x2 of tmpfs per refused connection — the
+            # cleanup below owns it from here on
+            lane = None
+            if self._lane_mode == "shm":
+                try:
+                    lane = ShmLane.create(self._shm_data, self._shm_slots)
+                except Exception as e:
+                    logging.warning(
+                        "node %d: shm slab creation failed (%s: %s) — "
+                        "dialing pure TCP", self.node_id,
+                        type(e).__name__, e,
+                    )
+                    get_telemetry().inc("comm.shm_fallbacks",
+                                        reason="create")
+                    lane = None
             try:
-                sock.sendall(self._hello_line())
+                sock.sendall(self._hello_line(lane))
                 f = sock.makefile("rb")
                 # wait for the hub's registration ACK — guaranteed to be
                 # the FIRST line on the conn (the hub ACKs before
@@ -1145,10 +1359,19 @@ class TcpBackend(CommBackend):
                 # interleave it); afterwards, any frame sent TO this
                 # node can be delivered
                 ack = f.readline()
-                if not ack or json.loads(ack).get(HUB_KEY) != "ack":
+                ack_obj = json.loads(ack) if ack else {}
+                if ack_obj.get(HUB_KEY) != "ack":
                     raise ConnectionError(
                         f"node {self.node_id}: no hub ACK"
                     )
+                if lane is not None and not ack_obj.get("shm"):
+                    # hub could not (or would not) map the slab: stay
+                    # pure TCP on this connection — the automatic
+                    # cross-host / old-hub downgrade
+                    lane.close(unlink=True)
+                    lane = None
+                    get_telemetry().inc("comm.shm_fallbacks",
+                                        reason="attach")
                 # handshake phase 2: the hub does NOT register this
                 # conn until it reads ``ping_done`` (before that, its
                 # reader thread can reply to clock-sync pings directly
@@ -1167,18 +1390,35 @@ class TcpBackend(CommBackend):
                     sock.close()
                 except OSError:
                     pass
+                if lane is not None:
+                    lane.close(unlink=True)
                 raise
             sock.settimeout(None)
-            # close the connection being replaced (reconnect path) —
-            # without this every reconnect cycle leaks an fd
-            for stale in (getattr(self, "_file", None),
-                          getattr(self, "_sock", None)):
-                if stale is not None:
-                    try:
-                        stale.close()
-                    except OSError:
-                        pass
+            if keep_stale:
+                # rebind path: the OLD connection must stay registered
+                # until the hub processes the new hello (that overlap
+                # IS what makes it a counted rebind, and the hub then
+                # closes the displaced conn itself) — park it for the
+                # next rebind/stop to reap
+                self._stale_conn = (getattr(self, "_file", None),
+                                    getattr(self, "_sock", None),
+                                    self._lane)
+            else:
+                # close the connection being replaced (reconnect path)
+                # — without this every reconnect cycle leaks an fd (and
+                # its slab: each dial advertises a FRESH lane, so the
+                # old one is unlinked here)
+                for stale in (getattr(self, "_file", None),
+                              getattr(self, "_sock", None)):
+                    if stale is not None:
+                        try:
+                            stale.close()
+                        except OSError:
+                            pass
+                if self._lane is not None:
+                    self._lane.close(unlink=True)
             self._sock, self._file = sock, f
+            self._lane = lane
 
     def _clock_sync(self, sock: socket.socket, f, pings: int = 8) -> None:
         """NTP-style handshake ping burst (tracing on only): the hub is
@@ -1229,6 +1469,16 @@ class TcpBackend(CommBackend):
         across attempts (and across broadcast receivers) — the frame is
         encoded exactly once however many times it is written.
         """
+        if self._lane is not None and len(parts) > 1:
+            body = parts[1:]
+            nbody = sum(len(p) for p in body)
+            # nbody > 0: a zero-byte body must ride inline — the
+            # receiver's `binlen and sseq` gate never reads an empty
+            # descriptor, so publishing one would desync the lane seq
+            if (nbody and nbody >= self._shm_min
+                    and self._send_parts_shm(parts, body, nbody,
+                                             msg_type)):
+                return
         delay = 0.05
         for attempt in range(self.send_retries + 1):
             try:
@@ -1242,6 +1492,39 @@ class TcpBackend(CommBackend):
                 time.sleep(delay * (1.0 + _retry_jitter(self.node_id,
                                                         attempt)))
                 delay = min(delay * 2.0, 2.0)
+
+    def _send_parts_shm(self, parts: List, body: List, nbody: int,
+                        msg_type: str) -> bool:
+        """Lane attempt for one frame: payload into the outbound ring,
+        then the header line — with the doorbell key spliced in — over
+        TCP.  Returns False (caller ships the whole frame inline) on
+        any refusal: ring/descriptor-queue full, oversized payload, or
+        a doorbell write error (the reserved ring space is simply never
+        committed, so the rollback is free).  Counted either way."""
+        tel = get_telemetry()
+        with self._send_lock:
+            lane = self._lane
+            if lane is None:
+                return False  # reconnect swapped the conn mid-call
+            pending = lane.try_send(body, nbody)
+            if pending is None:
+                tel.inc("comm.shm_fallbacks", reason=lane.last_refusal)
+                return False
+            hdr = json.loads(parts[0])
+            hdr[SHM_SEQ_KEY] = ShmLane.seq_of(pending)
+            try:
+                _sendall_parts(self._sock,
+                               [(json.dumps(hdr) + "\n").encode()])
+            except OSError:
+                # a partial doorbell garbles the stream and the hub
+                # drops the conn — same contract as any partial write;
+                # the caller's retry path covers the frame
+                tel.inc("comm.shm_fallbacks", reason="send_error")
+                return False
+            lane.commit(pending)
+        tel.inc("comm.shm_frames", msg_type=msg_type)
+        tel.inc("comm.shm_bytes", nbody, msg_type=msg_type)
+        return True
 
     def send_message(self, msg: Message) -> None:
         self._send_message_as(msg, self.node_id)
@@ -1390,12 +1673,23 @@ class TcpBackend(CommBackend):
                         frame = json.loads(line) if line else None
                         # a v2 frame announces its binary payload —
                         # consume it HERE or the next readline would
-                        # parse payload bytes as headers
+                        # parse payload bytes as headers (shm doorbells
+                        # consume their slab descriptor the same way;
+                        # the rare pre-run delivery takes the one-copy
+                        # read — these are small early frames)
                         binlen = (frame.get(FRAME_BINLEN_KEY)
                                   if isinstance(frame, dict) else None)
-                        payload = self._file.read(binlen) if binlen else b""
-                        if binlen and len(payload) < binlen:
-                            line = b""  # torn frame == EOF
+                        sseq = (frame.pop(SHM_SEQ_KEY, None)
+                                if isinstance(frame, dict) else None)
+                        if binlen and sseq is not None:
+                            if self._lane is None:
+                                raise ShmLaneError("no lane attached")
+                            payload = self._lane.read_copy(sseq, binlen)
+                        else:
+                            payload = (self._file.read(binlen)
+                                       if binlen else b"")
+                            if binlen and len(payload) < binlen:
+                                line = b""  # torn frame == EOF
                     except TimeoutError:
                         # mid-frame timeout: the stream can no longer
                         # be trusted frame-aligned (ADVICE r2) — kill
@@ -1410,6 +1704,14 @@ class TcpBackend(CommBackend):
                         raise ConnectionError(
                             f"node {self.node_id}: hub connection failed "
                             f"during {op}: {e}"
+                        ) from e
+                    except ShmLaneError as e:
+                        # lane bookkeeping untrustworthy: same contract
+                        # as a mid-frame timeout — the stream dies
+                        self._kill_connection()
+                        raise ConnectionError(
+                            f"node {self.node_id}: shm lane error during "
+                            f"{op}: {e}"
                         ) from e
                     if not line:
                         raise ConnectionError(
@@ -1466,6 +1768,42 @@ class TcpBackend(CommBackend):
         except OSError:
             pass
 
+    def _reap_stale_conn(self) -> None:
+        stale = self._stale_conn
+        if stale is None:
+            return
+        self._stale_conn = None
+        f, sock, lane = stale
+        for closer in (f, sock):
+            if closer is not None:
+                try:
+                    closer.close()
+                except OSError:
+                    pass
+        if lane is not None:
+            lane.close(unlink=True)  # hub already unlinked: no-op
+
+    def rebind_connection(self) -> None:
+        """Churn injection: dial a FRESH connection (new hello, new
+        slab) while the old one is STILL registered — the silent-death
+        shape, where the hub learns about the replacement only from
+        the new hello and counts a REBIND for every id (the
+        ``hub.node_rebinds`` policy).  The displaced socket is left
+        open deliberately: the hub's registration of the new conn is
+        deferred to its ``ping_done``, so closing the old one here
+        would race that registration and sometimes demote the rebind
+        to a plain re-register.  The HUB closes the displaced conn
+        once every id moved; we reap the dead fd/slab at the next
+        rebind or stop.  The reader resumes on the swapped stream
+        without spending a reconnect retry."""
+        self._reap_stale_conn()
+        # a failed re-dial needs no rollback: the reader tells a
+        # displaced socket's EOF from a genuine one by comparing the
+        # stream it was blocked on against the live self._file —
+        # if the dial never swapped it, ordinary drop semantics (the
+        # auto_reconnect budget) take over on their own
+        self._dial(keep_stale=True)
+
     def await_peers(self, ids, timeout: float = 60.0) -> None:
         """Block until every node id in ``ids`` is registered at the hub.
 
@@ -1508,6 +1846,8 @@ class TcpBackend(CommBackend):
                 closer()
             except OSError:
                 pass
+        if self._lane is not None:
+            self._lane.close(unlink=True)
 
     def run(self) -> None:
         retries = self.auto_reconnect
@@ -1515,8 +1855,14 @@ class TcpBackend(CommBackend):
         while not self._stopped.is_set():
             frame = None
             payload = b""
+            region = None
             try:
-                line = self._file.readline()
+                # pin the stream for this whole iteration: a rebind
+                # (mux flush hook, churn soak) swaps self._file while
+                # this thread may be blocked right here, and the EOF
+                # check below tells the two apart by identity
+                f = self._file
+                line = f.readline()
                 if line:
                     try:
                         frame = json.loads(line)
@@ -1534,8 +1880,29 @@ class TcpBackend(CommBackend):
                         line, frame = b"", None
                     binlen = (frame.get(FRAME_BINLEN_KEY)
                               if isinstance(frame, dict) else None)
-                    if binlen:
-                        payload = self._file.read(binlen)
+                    sseq = (frame.pop(SHM_SEQ_KEY, None)
+                            if isinstance(frame, dict) else None)
+                    if binlen and sseq is not None:
+                        # shm doorbell: the payload lives in the slab —
+                        # map it zero-copy; any descriptor skew means
+                        # the lane's bookkeeping is untrustworthy
+                        # (torn writer) and the CONNECTION dies, same
+                        # as a garbled stream
+                        try:
+                            if self._lane is None:
+                                raise ShmLaneError("no lane attached")
+                            region = self._lane.read(sseq, binlen)
+                            payload = region.view
+                        except ShmLaneError as e:
+                            logging.warning(
+                                "node %d: shm lane error (%s) — "
+                                "dropping connection", self.node_id, e,
+                            )
+                            get_telemetry().inc("comm.shm_fallbacks",
+                                                reason="torn")
+                            line, frame = b"", None
+                    elif binlen:
+                        payload = f.read(binlen)
                         if len(payload) < binlen:
                             # torn frame: the hub died mid-payload — the
                             # stream can't be trusted, treat as EOF (the
@@ -1544,6 +1911,15 @@ class TcpBackend(CommBackend):
             except OSError:
                 line = b""
             if not line:
+                if f is not self._file:
+                    # rebind_connection swapped in a live, registered
+                    # stream while this thread was blocked on the OLD
+                    # socket: this EOF is the displaced conn dying —
+                    # keep reading, no retry spent.  Identity (not a
+                    # flag): a rebind issued ON this thread (the mux
+                    # flush hook) never blocks here, so a flag set for
+                    # it would mis-absorb the NEXT genuine EOF.
+                    continue
                 if self._stopped.is_set() or retries <= 0:
                     return
                 retries -= 1
@@ -1575,50 +1951,77 @@ class TcpBackend(CommBackend):
                         "node %d: reconnect failed", self.node_id
                     )
                     continue  # retry until the budget runs out
-            if frame.get(HUB_KEY) == "stop":
-                return
-            if frame.get(HUB_KEY) == MCAST_STRIPE_KIND:
-                try:
-                    self._on_stripe(frame, payload,
-                                    nbytes=len(line) + len(payload))
-                except Exception:
-                    # reassembly bugs must degrade to a dropped logical
-                    # frame (straggler semantics), never a dead reader
-                    logging.exception("node %d: stripe reassembly failed",
-                                      self.node_id)
-                continue
-            if frame.get(HUB_KEY) == MUX_KIND:
-                try:
-                    self._on_mux_frame(frame, payload,
-                                       nbytes=len(line) + len(payload))
-                except Exception:
-                    # a demux bug must degrade to a dropped broadcast
-                    # copy, never a dead reader
-                    logging.exception("node %d: mux demux failed",
-                                      self.node_id)
-                continue
-            if frame.get(HUB_KEY) == "conn_map":
-                # hub introspection reply (request_conn_map): atomic
-                # reference swap — readers (the robust aggregator's
-                # connection attribution) always see a complete map
-                try:
-                    self._conn_map = {
-                        int(c): [int(n) for n in nodes]
-                        for c, nodes in (frame.get("conns") or {}).items()
-                    }
-                except (TypeError, ValueError):
-                    logging.warning("node %d: malformed conn_map reply",
-                                    self.node_id)
-                continue
             try:
-                # exact wire bytes: header line + binary payload
-                self._notify(Message.from_frame(frame, payload),
-                             nbytes=len(line) + len(payload))
+                keep_going = self._dispatch_frame(
+                    frame, payload, len(line) + len(payload), region
+                )
+            finally:
+                if region is not None:
+                    # the reader's reference: consumers that handed the
+                    # payload to another thread pinned their own
+                    # (Message.pin_payload) — the ring reclaims the
+                    # bytes once the LAST reference drops
+                    region.release()
+            if not keep_going:
+                return
+
+    def _dispatch_frame(self, frame: dict, payload, nbytes: int,
+                        region=None) -> bool:
+        """Route one complete inbound frame (payload possibly a slab
+        memoryview — ``region`` then owns its reclamation).  Returns
+        False only for the stop sentinel."""
+        if frame.get(HUB_KEY) == "stop":
+            return False
+        if frame.get(HUB_KEY) == MCAST_STRIPE_KIND:
+            if region is not None:
+                # stripe chunks are BUFFERED across frames until the
+                # stream completes — unbounded retention, so this one
+                # consumer materializes (one copy) instead of pinning
+                # slab space for a whole logical frame
+                payload = bytes(payload)
+            try:
+                self._on_stripe(frame, payload, nbytes=nbytes)
             except Exception:
-                # a handler error must not kill the reader thread — the
-                # node would silently stop receiving and the federation
-                # would hang with no attributable cause
-                logging.exception("node %d: message handler failed", self.node_id)
+                # reassembly bugs must degrade to a dropped logical
+                # frame (straggler semantics), never a dead reader
+                logging.exception("node %d: stripe reassembly failed",
+                                  self.node_id)
+            return True
+        if frame.get(HUB_KEY) == MUX_KIND:
+            try:
+                self._on_mux_frame(frame, payload, nbytes=nbytes,
+                                   region=region)
+            except Exception:
+                # a demux bug must degrade to a dropped broadcast
+                # copy, never a dead reader
+                logging.exception("node %d: mux demux failed",
+                                  self.node_id)
+            return True
+        if frame.get(HUB_KEY) == "conn_map":
+            # hub introspection reply (request_conn_map): atomic
+            # reference swap — readers (the robust aggregator's
+            # connection attribution) always see a complete map
+            try:
+                self._conn_map = {
+                    int(c): [int(n) for n in nodes]
+                    for c, nodes in (frame.get("conns") or {}).items()
+                }
+            except (TypeError, ValueError):
+                logging.warning("node %d: malformed conn_map reply",
+                                self.node_id)
+            return True
+        try:
+            # exact wire bytes: header line + binary payload
+            msg = Message.from_frame(frame, payload)
+            msg._region = region
+            self._notify(msg, nbytes=nbytes)
+        except Exception:
+            # a handler error must not kill the reader thread — the
+            # node would silently stop receiving and the federation
+            # would hang with no attributable cause
+            logging.exception("node %d: message handler failed",
+                              self.node_id)
+        return True
 
     def _on_stripe(self, frame: dict, chunk: bytes, nbytes: int) -> None:
         """One ``mcast_stripe`` continuation frame off the wire.
@@ -1750,8 +2153,8 @@ class TcpBackend(CommBackend):
         trace_ctx.stamp_msg(msg, self.node_id, "reasm", t=ent["t0"])
         self._notify(msg, nbytes=ent["nbytes"])
 
-    def _on_mux_frame(self, frame: dict, payload: bytes,
-                      nbytes: int) -> None:
+    def _on_mux_frame(self, frame: dict, payload, nbytes: int,
+                      region=None) -> None:
         """A ``__hub__: mux`` wrapped broadcast copy.  Only muxed
         backends (hello v2) are ever addressed with these; a plain
         backend receiving one is a hub bug — drop it loudly (straggler
@@ -1773,3 +2176,9 @@ class TcpBackend(CommBackend):
             self._sock.close()
         except OSError:
             pass
+        if self._lane is not None:
+            # creator-side detach + unlink; a region still pinned by a
+            # late consumer keeps its mapping alive until released (the
+            # segment name just disappears, which is the point)
+            self._lane.close(unlink=True)
+        self._reap_stale_conn()

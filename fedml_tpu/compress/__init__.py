@@ -8,6 +8,7 @@ compiled-engine integration in ``fedml_tpu/algorithms/fedavg.py``
 """
 
 from fedml_tpu.compress.codecs import (
+    BCAST_STREAM,
     COMPRESS_STREAM,
     Bf16Codec,
     IdentityCodec,
@@ -26,6 +27,7 @@ from fedml_tpu.compress.codecs import (
 from fedml_tpu.compress.error_feedback import ErrorFeedback
 
 __all__ = [
+    "BCAST_STREAM",
     "COMPRESS_STREAM",
     "Bf16Codec",
     "ErrorFeedback",

@@ -59,6 +59,11 @@ PyTree = Any
 # 2 = update compression — per-client keys then fold in the GLOBAL slot
 # id, so streams never collide across uses or devices
 COMPRESS_STREAM = 2
+# 3 = downlink broadcast compression (fedavg_cross_device delta mode):
+# ONE stream per round for the server's chain-update encode — no slot
+# fold (the broadcast is cohort-shared), disjoint from every per-client
+# stream above
+BCAST_STREAM = 3
 
 _CHUNK = 256  # per-chunk scale granularity (fp32 scale per 256 values)
 

@@ -81,25 +81,38 @@ def _dial_with_retry(factory, retries: int = 50):
             time.sleep(0.1)
 
 
+def _lane_kwargs(args) -> dict:
+    """Transport-lane knobs shared by every dialing role: ``--lane shm``
+    creates a per-connection shared-memory slab (payload bytes ride its
+    rings, headers stay on TCP; automatic per-frame TCP fallback)."""
+    return {
+        "lane": args.lane,
+        "shm_data_bytes": args.shm_mib << 20,
+        "shm_min_bytes": args.shm_min_bytes,
+    }
+
+
 def _connect_backend(node_id: int, host: str, port: int, retries: int = 50,
-                     auto_reconnect: int = 0, wire: int = 2):
+                     auto_reconnect: int = 0, wire: int = 2, **lane_kw):
     from fedml_tpu.comm.tcp import TcpBackend
 
     return _dial_with_retry(
         lambda: TcpBackend(node_id, host, port,
-                           auto_reconnect=auto_reconnect, wire=wire),
+                           auto_reconnect=auto_reconnect, wire=wire,
+                           **lane_kw),
         retries)
 
 
 def _connect_mux_backend(node_ids, host: str, port: int, retries: int = 50,
-                         auto_reconnect: int = 0, wire: int = 2):
+                         auto_reconnect: int = 0, wire: int = 2, **lane_kw):
     """Muxed twin of ``_connect_backend``: one hello-v2 dial registers
     the whole virtual-client range."""
     from fedml_tpu.comm.mux import TcpMuxBackend
 
     return _dial_with_retry(
         lambda: TcpMuxBackend(node_ids, host, port,
-                              auto_reconnect=auto_reconnect, wire=wire),
+                              auto_reconnect=auto_reconnect, wire=wire,
+                              **lane_kw),
         retries)
 
 
@@ -210,7 +223,8 @@ def _start_stats_reporter(args, backend, mgr, nodes):
 
 def run_hub(host: str, port: int, run_dir: str = "",
             stats_interval: float = 1.0, fanout: str = "striped",
-            stripe_kib: int = 256, stripe_pace: int = 8) -> None:
+            stripe_kib: int = 256, stripe_pace: int = 8,
+            shm_min_bytes: int = 1024) -> None:
     from fedml_tpu.comm.tcp import TcpHub
 
     # striped fan-out is the DEFAULT hub mode: multicast payloads split
@@ -222,7 +236,8 @@ def run_hub(host: str, port: int, run_dir: str = "",
     hub = TcpHub(host, port,
                  stripe_bytes=(stripe_kib << 10) if fanout == "striped"
                  else 0,
-                 max_inflight_stripes=stripe_pace)
+                 max_inflight_stripes=stripe_pace,
+                 shm_min_bytes=shm_min_bytes)
     # announce the bound port on stdout for the launcher
     print(json.dumps({"hub_port": hub.port}), flush=True)
     stop = {"flag": False}
@@ -270,7 +285,7 @@ def run_server(args) -> None:
     backend = _maybe_chaos(
         _connect_backend(0, args.host, args.port,
                          auto_reconnect=max(args.auto_reconnect, 0),
-                         wire=args.wire),
+                         wire=args.wire, **_lane_kwargs(args)),
         "server",
     )
     # cohort-wide pack geometry (fedavg_cross_device.py:62-66): each
@@ -346,6 +361,14 @@ def run_server(args) -> None:
         status_dir=args.run_dir or None,
         stats_interval=args.report_interval,
         defense=defense,
+        # delta/dedup broadcast (--bcast delta): sync ships the int8
+        # chain update against each node's last-acked round; --bcast-
+        # codec "" resolves to qsgd8 in delta mode / none (legacy) in
+        # full mode, and an explicit codec on a full run turns on the
+        # same quantized chain for the delta-vs-full digest pin
+        bcast=args.bcast,
+        bcast_codec=args.bcast_codec,
+        delta_base_window=args.delta_base_window,
     )
     # startup barrier: the hub drops frames to unregistered receivers,
     # so broadcasting before every client registered would hang
@@ -396,7 +419,8 @@ def run_server(args) -> None:
         "faults": {k: v for k, v in snap.items()
                    if k.startswith(("faults.", "robust.", "comm.unhandled",
                                     "comm.send_retries", "comm.send_failed",
-                                    "comm.reconnects"))},
+                                    "comm.reconnects", "comm.shm_",
+                                    "comm.delta_"))},
         # exact server-side wire accounting (TcpBackend counts header +
         # binary payload): the compression measurement reads C2S bytes
         # off this line across baseline/compressed federations
@@ -431,7 +455,8 @@ def run_client(args) -> None:
     reconnect = args.auto_reconnect if args.auto_reconnect >= 0 else 3
     backend = _maybe_chaos(
         _connect_backend(args.node_id, args.host, args.port,
-                         auto_reconnect=reconnect, wire=args.wire),
+                         auto_reconnect=reconnect, wire=args.wire,
+                         **_lane_kwargs(args)),
         "client", plan,
     )
     mgr = FedAvgClientManager(
@@ -484,7 +509,8 @@ def run_muxer(args) -> None:
     plan = _chaos_plan()
     reconnect = args.auto_reconnect if args.auto_reconnect >= 0 else 3
     mux = _connect_mux_backend(node_ids, args.host, args.port,
-                               auto_reconnect=reconnect, wire=args.wire)
+                               auto_reconnect=reconnect, wire=args.wire,
+                               **_lane_kwargs(args))
     # chaos parity: the plan wraps each VIRTUAL node's backend, so
     # fault decisions are keyed by virtual node id — the exact per-node
     # streams the one-process-per-client topology would draw
@@ -509,6 +535,7 @@ def run_muxer(args) -> None:
         train_delay=args.train_delay,
         crash_at_round=min(crash_rounds) if crash_rounds else None,
         wrap_backend=wrap,
+        rejoin_every_round=args.rejoin_every_round,
     )
     mlog = _node_metrics_logger(args.run_dir, f"mux{args.node_id}")
     if mlog is not None:
@@ -568,6 +595,13 @@ def launch(
     codec: str = "none",
     wire: int = 2,
     input_dim: int = 8,
+    lane: str = "tcp",
+    shm_mib: int = 64,
+    shm_min_bytes: int = 1024,
+    bcast: str = "full",
+    bcast_codec: str = "",
+    delta_base_window: int = 4,
+    mux_rejoin_every_round: bool = False,
     hotpath: str = "fast",
     fanout: str = "striped",
     stripe_kib: int = 256,
@@ -657,6 +691,8 @@ def launch(
         hub_flags = rd_flags + ["--fanout", fanout,
                                 "--stripe-kib", str(stripe_kib),
                                 "--stripe-pace", str(stripe_pace)]
+        if shm_min_bytes != 1024:
+            hub_flags += ["--shm-min-bytes", str(shm_min_bytes)]
         hub = subprocess.Popen(
             me + ["--role", "hub", "--port", "0"] + hub_flags,
             stdout=subprocess.PIPE, text=True, env=env,
@@ -676,6 +712,18 @@ def launch(
             common += ["--wire", str(wire)]
         if input_dim != 8:
             common += ["--input-dim", str(input_dim)]
+        if lane != "tcp":
+            common += ["--lane", lane]
+        if shm_mib != 64:
+            common += ["--shm-mib", str(shm_mib)]
+        if shm_min_bytes != 1024:
+            common += ["--shm-min-bytes", str(shm_min_bytes)]
+        if bcast != "full":
+            common += ["--bcast", bcast]
+        if bcast_codec:
+            common += ["--bcast-codec", bcast_codec]
+        if delta_base_window != 4:
+            common += ["--delta-base-window", str(delta_base_window)]
         if hotpath != "fast":
             common += ["--hotpath", hotpath]
         if decode_workers != 2:
@@ -712,6 +760,8 @@ def launch(
                 mux_procs.append(subprocess.Popen(
                     me + ["--role", "muxer", "--node-id", str(start),
                           "--virtual-clients", str(size)] + common
+                    + (["--rejoin-every-round"]
+                       if mux_rejoin_every_round else [])
                     + (["--crash-at-round", str(crash_muxer_at_round)]
                        if crash_muxer_at_round >= 0 and j == 0 else []),
                     env=env,
@@ -906,6 +956,24 @@ def main(argv=None):
     p.add_argument("--codec", default="none")
     p.add_argument("--wire", type=int, choices=[1, 2], default=2)
     p.add_argument("--input-dim", type=int, default=8)
+    # raw-speed transport knobs (fedml_tpu/comm/shm.py +
+    # fedavg_cross_device delta mode): --lane shm moves same-box
+    # payload bytes through a per-connection shared-memory ring slab
+    # (--shm-mib sized, payloads under --shm-min-bytes stay inline;
+    # cross-host peers / full rings fall back to TCP per frame,
+    # counted); --bcast delta ships each sync as the int8-encoded
+    # chain update against the receiver's last-acked round
+    # (--bcast-codec overrides the chain codec, --delta-base-window
+    # bounds the per-round delta log — older bases get a full resend)
+    p.add_argument("--lane", choices=["tcp", "shm"], default="tcp")
+    p.add_argument("--shm-mib", type=int, default=64)
+    p.add_argument("--shm-min-bytes", type=int, default=1024)
+    p.add_argument("--bcast", choices=["full", "delta"], default="full")
+    p.add_argument("--bcast-codec", default="")
+    p.add_argument("--delta-base-window", type=int, default=4)
+    # churn-soak knob (muxer role): drop + re-hello the hub connection
+    # and forget delta bases after every trained round
+    p.add_argument("--rejoin-every-round", action="store_true")
     # wire hot-path knobs: --hotpath legacy reverts the server to
     # per-node unicast broadcast + buffered close-time aggregation (the
     # pre-multicast behavior — the latency measurement's baseline arm
@@ -970,7 +1038,8 @@ def main(argv=None):
     if args.role == "hub":
         run_hub(args.host, args.port, args.run_dir, args.stats_interval,
                 fanout=args.fanout, stripe_kib=args.stripe_kib,
-                stripe_pace=args.stripe_pace)
+                stripe_pace=args.stripe_pace,
+                shm_min_bytes=args.shm_min_bytes)
     elif args.role == "server":
         run_server(args)
     elif args.role == "muxer":

@@ -141,6 +141,9 @@ def attack_message(msg: Message, factor: float) -> Optional[Message]:
         twin = Message()
         twin.params = dict(msg.params)
         twin.params[key] = {**value, "leaves": new_leaves}
+        # unmutated leaves are SHARED with the original — a slab-backed
+        # payload's residency travels with the twin (pin machinery)
+        twin._region = msg._region
         return twin
     return None
 
@@ -166,6 +169,7 @@ def corrupt_message(msg: Message, rng) -> Optional[Message]:
         twin = Message()
         twin.params = dict(msg.params)
         twin.params[key] = {**value, "leaves": new_leaves}
+        twin._region = msg._region  # shared uncorrupted leaves: see above
         return twin
     return None
 
@@ -345,8 +349,20 @@ class ChaosBackend(CommBackend):
                 with self._lock:
                     self._held[direction].append(new_hold)
             else:
+                # the timer outlives the transport's delivery scope: a
+                # slab-backed payload (shm lane) must be pinned until
+                # the re-injection ran, or the ring could reclaim the
+                # bytes under the delayed consumer (no-op off-lane)
+                unpin = msg.pin_payload()
+
+                def _deliver_late(m=msg, release=unpin):
+                    try:
+                        forward(m)
+                    finally:
+                        release()
+
                 t = threading.Timer(
-                    float(delay.get("delay_s", 0.05)), forward, args=(msg,)
+                    float(delay.get("delay_s", 0.05)), _deliver_late
                 )
                 t.daemon = True
                 t.start()

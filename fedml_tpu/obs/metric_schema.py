@@ -39,6 +39,12 @@ COUNTERS = {
     "comm.stripe_aborts": "striped logical frames killed (gap/crc/overflow/stale/undecodable) {reason=,msg_type=}",
     "comm.mux_frames": "muxed broadcast copies received on a shared connection {msg_type=}",
     "comm.mux_deliveries": "local fan-out deliveries to co-located virtual nodes {msg_type=}",
+    "comm.shm_frames": "frames whose payload rode the shared-memory lane {msg_type=}",
+    "comm.shm_bytes": "payload bytes carried through shm ring slabs {msg_type=}",
+    "comm.shm_fallbacks": "lane-eligible payloads shipped inline TCP instead {reason=}",
+    "comm.delta_bcast_bytes": "encoded bytes of delta-mode broadcast payloads",
+    "comm.delta_full_fallbacks": "delta-mode broadcasts that shipped the full model {reason=}",
+    "comm.delta_resyncs": "full-resync requests after an inapplicable delta sync",
     "hub.mcast_frames": "mcast control frames fanned out by the hub {msg_type=}",
     "hub.dropped_frames": "frames to unregistered/dead/over-bound receivers {msg_type=}",
     "hub.node_rebinds": "node ids re-claimed by a newer connection (new conn wins)",
@@ -68,6 +74,10 @@ GAUGES = {
     "hub.conn_nodes": "node ids registered on a connection {conn=}",
     "hub.node_rebinds_total": "cumulative id rebinds (time series form)",
     "hub.backpressure_drops_total": "cumulative over-bound queue drops",
+    "hub.shm_conns": "connections with an attached shared-memory lane",
+    "hub.shm_frames_total": "cumulative frames the hub moved via shm lanes",
+    "hub.shm_bytes_total": "cumulative payload bytes via shm lanes",
+    "hub.shm_fallbacks_total": "cumulative hub-side lane fallbacks to inline TCP",
     "hub.mcast_frames_total": "cumulative mcast frames (time series form)",
     "hub.stripe_frames_total": "cumulative enqueued mcast stripes (time series form)",
     "jax.device_mem_bytes": "device memory in use {device=}",

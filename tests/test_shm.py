@@ -1,0 +1,412 @@
+"""Shared-memory lane tests: ring mechanics, torn-writer detection,
+fallback reasons, and the shm-vs-tcp byte-identity pins over a real hub
+(fedml_tpu/comm/shm.py + its tcp.py integration)."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from fedml_tpu.comm import tcp as tcp_mod
+from fedml_tpu.comm.message import MSG_TYPE_C2S_SEND_MODEL, Message
+from fedml_tpu.comm.shm import (
+    ShmLane,
+    ShmLaneError,
+    split_frame_line,
+)
+from fedml_tpu.comm.tcp import TcpBackend, TcpHub
+from fedml_tpu.obs.telemetry import get_telemetry
+
+
+def _counters():
+    return dict(get_telemetry().snapshot()["counters"])
+
+
+def _lane_pair(data=1 << 16, slots=4):
+    a = ShmLane.create(data_bytes=data, nslots=slots)
+    b = ShmLane.attach(a.describe())
+    return a, b
+
+
+def _send(lane, payload: bytes):
+    pending = lane.try_send([payload], len(payload))
+    assert pending is not None, lane.last_refusal
+    return lane.commit(pending)
+
+
+# --- ring mechanics ----------------------------------------------------------
+
+
+def test_lane_roundtrip_and_wraparound():
+    """Frames cross the slab byte-exact, and a stream several times the
+    data region's size wraps cleanly (the wrap-skip accounting)."""
+    tx, rx = _lane_pair(data=1 << 14, slots=4)  # 16 KiB ring
+    try:
+        for i in range(40):  # ~40 x 5 KB through a 16 KB ring
+            blob = bytes([i % 251]) * (5000 + i)
+            seq = _send(tx, blob)
+            region = rx.read(seq, len(blob))
+            assert bytes(region.view) == blob
+            region.release()
+    finally:
+        rx.close()
+        tx.close()
+
+
+def test_lane_descriptor_queue_full_then_ring_full_fallback():
+    """Unreleased frames exhaust the descriptor ring first (slots),
+    then the byte ring — each refusal names its reason and the lane
+    stays usable once regions release."""
+    tx, rx = _lane_pair(data=1 << 14, slots=2)
+    try:
+        regions = []
+        for _ in range(2):
+            seq = _send(tx, b"x" * 1000)
+            regions.append(rx.read(seq, 1000))
+        assert tx.try_send([b"y" * 1000], 1000) is None
+        assert tx.last_refusal == "desc_full"
+        # oversized is its own reason, independent of occupancy
+        big = b"z" * (1 << 15)
+        assert tx.try_send([big], len(big)) is None
+        assert tx.last_refusal == "too_big"
+        for r in regions:
+            r.release()
+        # slots free again: byte-ring pressure is the next limit
+        seq = _send(tx, b"a" * 10000)
+        r = rx.read(seq, 10000)
+        assert tx.try_send([b"b" * 10000], 10000) is None
+        assert tx.last_refusal == "ring_full"
+        r.release()
+        assert tx.try_send([b"b" * 10000], 10000) is not None
+    finally:
+        rx.close()
+        tx.close()
+
+
+def test_lane_out_of_order_release_reclaims_in_order():
+    """Regions released out of order (the decode-pool shape) reclaim
+    only up to the lowest unreleased frame, then all at once."""
+    tx, rx = _lane_pair(data=1 << 14, slots=8)
+    try:
+        blobs = [bytes([i]) * 3000 for i in range(4)]
+        regions = [rx.read(_send(tx, b), len(b)) for b in blobs]
+        # release 1..3 but NOT 0: nothing reclaims, ring fills
+        for r in regions[1:]:
+            r.release()
+        assert tx.try_send([b"x" * 8000], 8000) is None
+        assert tx.last_refusal == "ring_full"
+        regions[0].release()  # the head: everything reclaims
+        assert tx.try_send([b"x" * 8000], 8000) is not None
+    finally:
+        rx.close()
+        tx.close()
+
+
+def test_lane_torn_descriptor_is_fatal():
+    """A descriptor whose crc/fields don't validate (writer killed
+    mid-publish) raises ShmLaneError — the connection-fatal contract:
+    no partial frame is ever delivered."""
+    tx, rx = _lane_pair()
+    try:
+        seq = _send(tx, b"q" * 2000)
+        # tear the descriptor: flip a byte inside the slot
+        buf = tx._seg.buf
+        desc_off = tx._wring._desc + (seq % tx.nslots) * 40
+        buf[desc_off + 8] ^= 0xFF
+        with pytest.raises(ShmLaneError):
+            rx.read(seq, 2000)
+        # doorbell/seq skew is equally fatal
+        with pytest.raises(ShmLaneError):
+            rx.read(seq + 5, 100)
+    finally:
+        rx.close()
+        tx.close()
+
+
+def test_lane_geometry_mismatch_refuses_attach():
+    a = ShmLane.create(data_bytes=1 << 16, nslots=4)
+    try:
+        desc = dict(a.describe())
+        desc["slots"] = 8
+        with pytest.raises(ShmLaneError):
+            ShmLane.attach(desc)
+    finally:
+        a.close()
+
+
+def test_split_frame_line_bytes_and_memoryview():
+    frame = b'{"h":1}\n' + b"\x00" * 10000
+    assert split_frame_line(frame) == 8
+    assert split_frame_line(memoryview(frame)) == 8
+    assert split_frame_line(b"no newline") == -1
+    assert split_frame_line(memoryview(b"no newline")) == -1
+    # newline past the first search chunk
+    far = b"x" * 9000 + b"\n" + b"y"
+    assert split_frame_line(memoryview(far)) == 9001
+
+
+def test_pin_payload_refcounts_region():
+    """Message.pin_payload keeps the slab bytes reserved past the
+    delivery scope; the ring reclaims only at the last release."""
+    tx, rx = _lane_pair(data=1 << 14, slots=4)
+    try:
+        seq = _send(tx, b"p" * 9000)
+        region = rx.read(seq, 9000)
+        msg = Message("T", 1, 0)
+        msg._region = region
+        unpin = msg.pin_payload()
+        clone = msg.clone_for(2)
+        unpin2 = clone.pin_payload()  # clones share residency
+        region.release()  # the reader's delivery-scope reference
+        assert tx.try_send([b"w" * 9000], 9000) is None  # still pinned
+        unpin()
+        assert tx.try_send([b"w" * 9000], 9000) is None  # one pin left
+        unpin2()
+        assert tx.try_send([b"w" * 9000], 9000) is not None
+        # off-lane messages: pinning is a free no-op
+        plain = Message("T", 1, 0)
+        plain.pin_payload()()
+    finally:
+        rx.close()
+        tx.close()
+
+
+# --- hub integration ---------------------------------------------------------
+
+
+def _kw(lane):
+    if lane != "shm":
+        return {}
+    return {"lane": "shm", "shm_min_bytes": 0,
+            "shm_data_bytes": 1 << 20, "shm_slots": 32}
+
+
+def test_shm_attach_failure_downgrades_to_tcp(monkeypatch):
+    """If the hub cannot map the advertised slab, the ACK refuses the
+    capability and the connection runs pure TCP — counted, no error."""
+    hub = TcpHub()
+    monkeypatch.setattr(
+        tcp_mod.ShmLane, "attach",
+        classmethod(lambda cls, desc: (_ for _ in ()).throw(
+            ShmLaneError("simulated cross-host attach"))),
+    )
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(np.asarray(m.get("x")).copy())
+
+    rx = tx = None
+    try:
+        before = _counters()
+        rx = TcpBackend(1, hub.host, hub.port, **_kw("shm"))
+        rx.add_observer(Obs())
+        rx.run_in_thread()
+        tx = TcpBackend(9, hub.host, hub.port, **_kw("shm"))
+        tx.await_peers([1])
+        assert tx._lane is None and rx._lane is None
+        after = _counters()
+        key = "comm.shm_fallbacks{reason=attach}"
+        assert after.get(key, 0) - before.get(key, 0) == 2
+        m = Message("T", 9, 1)
+        m.add_params("x", np.arange(50000, dtype=np.float32))
+        tx.send_message(m)
+        deadline = time.monotonic() + 10
+        while not got and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert got and got[0][-1] == 49999
+    finally:
+        for b in (rx, tx):
+            if b is not None:
+                b.stop()
+        hub.stop()
+
+
+def _run_tcp_federation(lane="tcp", bcast="full", bcast_codec="",
+                        codec="none", stripe_bytes=0, rounds=3,
+                        num_clients=2, seed=1):
+    """In-process hub + threads federation; returns (final leaves,
+    upload digests) — the byte-identity probes every lane/bcast pin
+    compares."""
+    from fedml_tpu.algorithms.fedavg_cross_device import (
+        FedAvgClientManager,
+        FedAvgServerManager,
+    )
+    from fedml_tpu.core.client import make_client_optimizer, make_local_update
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models.linear import logistic_regression
+
+    ds = synthetic_classification(
+        num_train=60 * num_clients, num_test=30, input_shape=(8,),
+        num_classes=2, num_clients=num_clients, partition="homo", seed=seed,
+    )
+    bundle = logistic_regression(8, 2)
+    init = bundle.init(jax.random.PRNGKey(seed))
+    lu = make_local_update(bundle, make_client_optimizer("sgd", 0.1), 1)
+    hub = TcpHub(stripe_bytes=stripe_bytes, shm_min_bytes=0)
+    backends = []
+    try:
+        server_backend = TcpBackend(0, hub.host, hub.port, **_kw(lane))
+        backends.append(server_backend)
+        client_backends = [
+            TcpBackend(i + 1, hub.host, hub.port, **_kw(lane))
+            for i in range(num_clients)
+        ]
+        backends += client_backends
+        server = FedAvgServerManager(
+            server_backend, init, num_clients=num_clients,
+            clients_per_round=num_clients, comm_rounds=rounds, seed=seed,
+            codec=codec, stats_plane=False,
+            bcast=bcast, bcast_codec=bcast_codec,
+        )
+        clients = [
+            FedAvgClientManager(cb, lu, ds, batch_size=16,
+                                template_variables=init, seed=seed)
+            for cb in client_backends
+        ]
+        threads = [cb.run_in_thread() for cb in client_backends]
+        server_thread = server_backend.run_in_thread()
+        server.start()
+        server_thread.join(timeout=120)
+        assert not server_thread.is_alive(), "server did not finish"
+        assert server.round_idx == rounds
+        for t in threads:
+            t.join(timeout=15)
+        leaves = [np.asarray(l).copy()
+                  for l in jax.tree_util.tree_leaves(server.variables)]
+        return leaves, [c.upload_digest for c in clients]
+    finally:
+        for b in backends:
+            b.stop()
+        hub.stop()
+
+
+def _assert_same(a, b, what):
+    leaves_a, dig_a = a
+    leaves_b, dig_b = b
+    assert dig_a == dig_b, f"{what}: upload digests differ"
+    for x, y in zip(leaves_a, leaves_b):
+        assert x.tobytes() == y.tobytes(), f"{what}: final model differs"
+
+
+@pytest.mark.parametrize("codec", ["none", "int8"])
+def test_shm_vs_tcp_federation_byte_identical(codec):
+    """THE lane pin: the shm lane is payload-transparent — same seed,
+    same uploads (fp32 AND int8+EF), same final model, byte for byte;
+    and the shm run actually moved payloads through slabs."""
+    before = _counters()
+    shm = _run_tcp_federation(lane="shm", codec=codec)
+    after = _counters()
+    moved = sum(v - before.get(k, 0) for k, v in after.items()
+                if k.startswith("comm.shm_frames"))
+    assert moved > 0, "shm run never used the lane"
+    tcp = _run_tcp_federation(lane="tcp", codec=codec)
+    _assert_same(shm, tcp, f"shm-vs-tcp ({codec})")
+
+
+def test_shm_delta_vs_tcp_delta_byte_identical():
+    """Lane x bcast composition: the delta broadcast's chain is
+    transport-independent too."""
+    shm = _run_tcp_federation(lane="shm", bcast="delta")
+    tcp = _run_tcp_federation(lane="tcp", bcast="delta")
+    _assert_same(shm, tcp, "shm-delta-vs-tcp-delta")
+
+
+def test_shm_striped_composes_byte_identical():
+    """Stripes over the lane (each stripe's chunk rides the ring) must
+    reassemble to the same federation outcome as whole frames."""
+    striped = _run_tcp_federation(lane="shm", stripe_bytes=512)
+    whole = _run_tcp_federation(lane="shm", stripe_bytes=0)
+    _assert_same(striped, whole, "shm-striped-vs-whole")
+
+
+def test_shm_ring_full_falls_back_inline():
+    """A lane whose ring cannot take the payload ships it inline TCP —
+    counted per frame, frames still delivered in order."""
+    hub = TcpHub(shm_min_bytes=0)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(np.asarray(m.get("x")).copy())
+
+    rx = tx = None
+    try:
+        rx = TcpBackend(1, hub.host, hub.port, lane="shm",
+                        shm_min_bytes=0, shm_data_bytes=1 << 14,
+                        shm_slots=4)
+        rx.add_observer(Obs())
+        rx.run_in_thread()
+        # the sender's ring is 16 KiB: a 400 KB payload can never fit
+        tx = TcpBackend(9, hub.host, hub.port, lane="shm",
+                        shm_min_bytes=0, shm_data_bytes=1 << 14,
+                        shm_slots=4)
+        tx.await_peers([1])
+        before = _counters()
+        for i in range(3):
+            m = Message(MSG_TYPE_C2S_SEND_MODEL, 9, 1)
+            m.add_params("x", np.full(100_000, i, np.float32))
+            tx.send_message(m)
+        deadline = time.monotonic() + 15
+        while len(got) < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert len(got) == 3
+        assert [g[0] for g in got] == [0.0, 1.0, 2.0]  # order kept
+        after = _counters()
+        fb = sum(v - before.get(k, 0) for k, v in after.items()
+                 if k.startswith("comm.shm_fallbacks"))
+        assert fb >= 3
+    finally:
+        for b in (rx, tx):
+            if b is not None:
+                b.stop()
+        hub.stop()
+
+
+def test_torn_writer_kills_connection_not_reader():
+    """Integration form of the torn-descriptor contract: garbage where
+    the descriptor should be makes the RECEIVER drop the connection
+    (reconnect semantics), with no partial frame delivered."""
+    hub = TcpHub(shm_min_bytes=0)
+    got = []
+
+    class Obs:
+        def receive_message(self, t, m):
+            got.append(m)
+
+    rx = tx = None
+    try:
+        rx = TcpBackend(1, hub.host, hub.port, lane="shm",
+                        shm_min_bytes=0, shm_data_bytes=1 << 16,
+                        shm_slots=4)
+        rx.add_observer(Obs())
+        reader = rx.run_in_thread()
+        tx = TcpBackend(9, hub.host, hub.port)
+        tx.await_peers([1])
+        # forge a doorbell straight onto rx's hub connection for a
+        # descriptor the hub never published (the slab's slot 0 is
+        # still zeroed): rx's crc/field validation must fail and the
+        # CONNECTION must die — never a partial/garbage frame delivered
+        from fedml_tpu.comm.message import (
+            FRAME_BINLEN_KEY,
+            SHM_SEQ_KEY,
+        )
+
+        with hub._lock:
+            st = hub._conns[1]
+        forged = (json.dumps({
+            "msg_type": "T", "sender": 9, "receiver": 1,
+            FRAME_BINLEN_KEY: 64, SHM_SEQ_KEY: 0,
+        }) + "\n").encode()
+        st.sock.sendall(forged)
+        reader.join(timeout=10)
+        assert not reader.is_alive(), "reader should drop the conn"
+        assert not got, "no partial frame may be delivered"
+    finally:
+        for b in (rx, tx):
+            if b is not None:
+                b.stop()
+        hub.stop()

@@ -29,7 +29,7 @@ import sys
 PREFIXES = (
     "BENCH_", "FEDLAT_", "FEDSCALE_", "FEDTRACE_", "FEDHEALTH_",
     "FAULTS_", "CONVERGENCE_", "COMPRESS_", "MULTICHIP_", "SCALING_",
-    "FEDERATION_", "ROBUST_",
+    "FEDERATION_", "ROBUST_", "FEDXPORT_", "FEDCHURN_",
 )
 
 _ROUND_RE = re.compile(r"[_-]r(\d+)")
@@ -151,6 +151,31 @@ def _extract(doc: dict, fname: str) -> dict:
             if v is not None:
                 out[k] = v
         ok = _deep_get(doc, "verdict.ok")
+        if ok is not None:
+            out["ok"] = bool(ok)
+    elif fname.startswith("FEDXPORT_"):
+        for arm in ("tcp_full", "shm_full", "tcp_delta", "shm_delta"):
+            v = _num(_deep_get(doc, f"ab32.p50_by_arm.{arm}"))
+            if v is not None:
+                out[f"p50[{arm}]"] = v
+        v = _num(_deep_get(doc, "ab32.bcast_bytes_per_round.ratio"))
+        if v is not None:
+            out["delta_bytes_ratio"] = v
+        v = _num(_deep_get(doc, "big256.shm_speedup"))
+        if v is not None:
+            out["shm_speedup_256"] = v
+        for k in ("digest_pins", "ab32", "big256"):
+            ok = _deep_get(doc, f"{k}.ok")
+            if ok is not None:
+                out[f"ok[{k}]"] = bool(ok)
+    elif fname.startswith("FEDCHURN_"):
+        v = _num(_deep_get(doc, "churn.node_rebinds"))
+        if v is not None:
+            out["node_rebinds"] = v
+        v = _num(_deep_get(doc, "churn.run.hub_peak_rss_mb"))
+        if v is not None:
+            out["hub_rss_mb"] = v
+        ok = _deep_get(doc, "churn.ok")
         if ok is not None:
             out["ok"] = bool(ok)
     elif fname.startswith("FAULTS_"):

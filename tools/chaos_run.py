@@ -43,6 +43,20 @@ round with a finite global model.  Default matrix:
                          cohort's uploads (the PR-10 Sybil surface);
                          norm clipping + per-connection contribution
                          caps must keep the aggregate finite
+    shm_ring_full        shm lane with a 1 MiB ring under a 2 MB model:
+                         EVERY model payload exceeds the ring, so every
+                         frame must take the counted per-frame TCP
+                         fallback — the run completes with zero stalls
+                         (the genuine ring_full/desc_full reasons are
+                         pinned at unit level in tests/test_shm.py)
+    shm_peer_crash       muxer on an shm lane os._exit()s mid-round:
+                         the hub's lane detach must look exactly like a
+                         dropped connection — survivors aggregate,
+                         degraded rounds, never a wedged slab
+
+    ``--lane shm`` / ``--bcast delta`` re-run the WHOLE matrix over the
+    new transport path (FEDXPORT acceptance: all prior scenarios
+    NaN-free over shm+delta).
 
 Per scenario the output records: survived, rounds completed, rounds
 aggregated empty (``zero_participant_rounds``), degraded rounds,
@@ -230,10 +244,34 @@ def _scenarios(round_timeout: float, num_clients: int = 3):
             "outlier_mult": 6.0,
             "conn_cap": 0.5,
         },
+        # every 2.1 MB model payload overflows the 1 MiB/direction ring:
+        # the lane must take the counted per-frame TCP fallback every
+        # time and the federation must finish with no stall (hub_stats
+        # + server shm counters carry the evidence)
+        "shm_ring_full": {
+            "lane": "shm",
+            "shm_mib": 1,
+            "shm_min_bytes": 0,
+            "input_dim": 262144,
+            "round_timeout": round_timeout,
+        },
+        # a muxer whose payloads ride an shm lane dies mid-round: slab
+        # detach == dropped connection (doorbells stop, hub cleans up),
+        # survivors keep aggregating — the muxer_crash contract over
+        # the new lane
+        "shm_peer_crash": {
+            "lane": "shm",
+            "shm_min_bytes": 0,
+            "muxers": 1,
+            "muxed_clients": -1,  # resolved to ceil(N/2) in run_scenario
+            "crash_muxer_at_round": 1,
+            "round_timeout": round_timeout,
+        },
     }
 
 
-def _final_model_eval(out_path: str, seed: int, num_clients: int):
+def _final_model_eval(out_path: str, seed: int, num_clients: int,
+                      input_dim: int = 8):
     """Load the server's final leaves and evaluate on the shared
     synthetic test split (every process builds the same problem from the
     seed, so this is the federation's real held-out accuracy)."""
@@ -245,7 +283,8 @@ def _final_model_eval(out_path: str, seed: int, num_clients: int):
     from fedml_tpu.core.types import batch_eval_pack
     from fedml_tpu.experiments.distributed_fedavg import _build_problem
 
-    ds, bundle, init, _ = _build_problem(seed, num_clients)
+    ds, bundle, init, _ = _build_problem(seed, num_clients,
+                                         input_dim=input_dim)
     leaves_like, treedef = jax.tree_util.tree_flatten(init)
     z = np.load(out_path)
     leaves = [np.asarray(z[f"leaf_{i}"]) for i in range(len(leaves_like))]
@@ -266,8 +305,13 @@ def _final_model_eval(out_path: str, seed: int, num_clients: int):
 
 
 def run_scenario(name: str, kwargs: dict, *, num_clients: int, rounds: int,
-                 seed: int, timeout: float) -> dict:
+                 seed: int, timeout: float, transport=None) -> dict:
     from fedml_tpu.experiments.distributed_fedavg import launch
+
+    if transport:
+        # matrix-wide transport overrides (--lane/--bcast): scenario-
+        # specific keys win (the shm scenarios pin their own lane)
+        kwargs = {**transport, **kwargs}
 
     out_path = os.path.join(
         tempfile.mkdtemp(prefix=f"chaos_{name}_"), "final.npz"
@@ -322,7 +366,8 @@ def run_scenario(name: str, kwargs: dict, *, num_clients: int, rounds: int,
             rec["slo_report"] = {"error": f"{type(e).__name__}: {e}"}
     if os.path.exists(out_path):
         try:
-            rec.update(_final_model_eval(out_path, seed, num_clients))
+            rec.update(_final_model_eval(out_path, seed, num_clients,
+                                         kwargs.get("input_dim", 8)))
         except Exception as e:
             rec["eval_error"] = f"{type(e).__name__}: {e}"
             rec["nan_free"] = False
@@ -347,6 +392,12 @@ def main(argv=None) -> int:
                         "host (~5-10 s on a loaded 1-core CI box)")
     p.add_argument("--timeout", type=float, default=240.0,
                    help="per-scenario hard cap on the server process")
+    # transport-path overrides: soak the WHOLE matrix over the shm lane
+    # and/or the delta broadcast (FEDXPORT acceptance re-run); the tiny
+    # chaos model's frames only exercise the lane at --shm-min-bytes 0
+    p.add_argument("--lane", choices=["tcp", "shm"], default="tcp")
+    p.add_argument("--bcast", choices=["full", "delta"], default="full")
+    p.add_argument("--shm-min-bytes", type=int, default=0)
     args = p.parse_args(argv)
 
     scenarios = _scenarios(args.round_timeout, args.num_clients)
@@ -357,11 +408,18 @@ def main(argv=None) -> int:
             return 2
         scenarios = {args.scenario: scenarios[args.scenario]}
 
+    transport = {}
+    if args.lane != "tcp":
+        transport["lane"] = args.lane
+        transport["shm_min_bytes"] = args.shm_min_bytes
+    if args.bcast != "full":
+        transport["bcast"] = args.bcast
+
     results = []
     for name, kwargs in scenarios.items():
         results.append(run_scenario(
             name, kwargs, num_clients=args.num_clients, rounds=args.rounds,
-            seed=args.seed, timeout=args.timeout,
+            seed=args.seed, timeout=args.timeout, transport=transport,
         ))
 
     baseline = next(
@@ -376,6 +434,8 @@ def main(argv=None) -> int:
 
     doc = {
         "matrix": args.matrix if not args.scenario else args.scenario,
+        "lane": args.lane,
+        "bcast": args.bcast,
         "num_clients": args.num_clients,
         "rounds": args.rounds,
         "seed": args.seed,

@@ -176,6 +176,19 @@ def run_scale_federation(clients: int, muxers: int, rounds: int,
         hub_peak_kb = _vm_kb(hub.pid, "VmHWM")
         wall = round(time.time() - t0, 1)
         rounds_done, walls, finite = _round_walls(out_path)
+        if info is not None:
+            # graceful hub stop so its shutdown stats line (rebind /
+            # shm / drop counters) lands in info too
+            hub.terminate()
+            try:
+                out, _ = hub.communicate(timeout=10)
+                for line in (out or "").splitlines():
+                    try:
+                        info.update(json.loads(line))
+                    except json.JSONDecodeError:
+                        continue
+            except subprocess.TimeoutExpired:
+                hub.kill()
         return {
             "clients": clients,
             "muxers": muxers,
@@ -232,6 +245,65 @@ def run_scale(args) -> dict:
         "ok": bool(big["rc"] == 0 and big["nan_free"]
                    and big["rounds"] >= 3
                    and ratio is not None and ratio < 4.0),
+    }
+
+
+# --- churn mode --------------------------------------------------------------
+
+def run_churn(args) -> dict:
+    """Connection-churn soak (PR 10's explicit leftover, run over the
+    PR 13 transport): every muxer drops + re-helloes its hub connection
+    after EVERY trained round and forgets its delta base cache, so each
+    round's delta broadcast finds cold rejoiners.  Asserted shape:
+
+    - the federation completes its rounds with a finite model (some
+      rounds degrade — a sync can land in a reconnect window; that is
+      the deadline's job, not a failure);
+    - hub ``node_rebinds`` grows ~muxers x rounds (every re-hello
+      rebinds the whole virtual id range);
+    - the delta broadcast walks every rejoiner back through the
+      full-model path (``comm.delta_full_fallbacks`` resync/no_ack > 0);
+    - hub peak RSS stays bounded (churn must not leak connections,
+      queues, or slabs).
+    """
+    _barrier()
+    info: dict = {}
+    flags = ["--bcast", "delta", "--rejoin-every-round",
+             "--auto-reconnect", "1000", "--shm-min-bytes", "0"]
+    if args.lane != "tcp":
+        flags += ["--lane", args.lane]
+    print(f"== churn soak: {args.churn_clients} virtual clients on "
+          f"{args.churn_muxers} rejoin-every-round muxers, "
+          f"{args.churn_rounds} rounds ==", flush=True)
+    res = run_scale_federation(
+        args.churn_clients, args.churn_muxers, args.churn_rounds,
+        seed=args.seed, batch_size=args.batch_size,
+        round_timeout=args.churn_round_timeout, timeout=args.timeout,
+        extra_flags=flags, info=info)
+    print(json.dumps(res), flush=True)
+    hub_stats = info.get("hub_stats") or {}
+    faults = info.get("faults") or {}
+    fallbacks = {k.split("reason=")[-1].rstrip("}"): v
+                 for k, v in faults.items()
+                 if k.startswith("comm.delta_full_fallbacks")}
+    rebinds = hub_stats.get("node_rebinds", 0)
+    min_rebinds = args.churn_muxers * max(1, args.churn_rounds - 2)
+    return {
+        "run": res,
+        "lane": args.lane,
+        "node_rebinds": rebinds,
+        "delta_full_fallbacks": fallbacks,
+        "hub_stats": hub_stats,
+        "server_counters": faults,
+        "thresholds_pre_declared": {
+            "min_node_rebinds": min_rebinds,
+            "full_fallbacks_required": True,
+            "hub_rss_mb_max": 256.0,
+        },
+        "ok": bool(res["rc"] == 0 and res["nan_free"]
+                   and rebinds >= min_rebinds
+                   and sum(fallbacks.values()) > 0
+                   and res["hub_peak_rss_mb"] < 256.0),
     }
 
 
@@ -331,7 +403,7 @@ def run_ab(args) -> dict:
 
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("--mode", choices=["scale", "ab", "both"],
+    p.add_argument("--mode", choices=["scale", "ab", "both", "churn"],
                    default="both")
     p.add_argument("--out", default="FEDSCALE_r10.json")
     p.add_argument("--seed", type=int, default=0)
@@ -351,6 +423,12 @@ def main(argv=None) -> int:
     p.add_argument("--train-samples", type=int, default=16)
     p.add_argument("--big-clients", type=int, default=256)
     p.add_argument("--big-muxers", type=int, default=1)
+    # churn knobs (PR 13): muxers re-hello every round over --lane
+    p.add_argument("--lane", choices=["tcp", "shm"], default="shm")
+    p.add_argument("--churn-clients", type=int, default=32)
+    p.add_argument("--churn-muxers", type=int, default=2)
+    p.add_argument("--churn-rounds", type=int, default=5)
+    p.add_argument("--churn-round-timeout", type=float, default=20.0)
     args = p.parse_args(argv)
 
     artifact = {
@@ -369,6 +447,9 @@ def main(argv=None) -> int:
     if args.mode in ("ab", "both"):
         artifact["latency_ab"] = run_ab(args)
         ok = ok and artifact["latency_ab"]["verdict"]["ok"]
+    if args.mode == "churn":
+        artifact["churn"] = run_churn(args)
+        ok = ok and artifact["churn"]["ok"]
     with open(args.out, "w") as fh:
         json.dump(artifact, fh, indent=1, default=float)
     print(json.dumps({"out": args.out, "ok": ok}))

@@ -212,6 +212,47 @@ def summarize(records: List[dict]) -> dict:
     for row in agg_by_cohort.values():
         row["mean_agg_s"] = row["total_agg_s"] / row["count"]
 
+    # transport split (shm lane + delta broadcast, PR 13): how many of
+    # the wire bytes rode shared-memory rings vs inline TCP, what the
+    # delta broadcast shipped vs fell back on, and the lane's fallback
+    # reasons — the raw-speed levers' accounting in one place
+    transport = {}
+    if telemetry:
+        ctr = telemetry.get("counters") or {}
+        sent = recv = shm = 0.0
+        shm_fallbacks = {}
+        delta_fallbacks = {}
+        for key, value in ctr.items():
+            name, labels = parse_metric_key(key)
+            if name == "comm.sent_bytes":
+                sent += value
+            elif name == "comm.recv_bytes":
+                recv += value
+            elif name == "comm.shm_bytes":
+                shm += value
+            elif name == "comm.shm_fallbacks":
+                shm_fallbacks[labels.get("reason", "?")] = value
+            elif name == "comm.delta_full_fallbacks":
+                delta_fallbacks[labels.get("reason", "?")] = value
+        total = sent + recv
+        if shm or shm_fallbacks or any(
+            parse_metric_key(k)[0].startswith("comm.delta_")
+            for k in ctr
+        ):
+            transport = {
+                "wire_bytes_total": total,
+                "shm_payload_bytes": shm,
+                "shm_share": (shm / total) if total else None,
+                "tcp_inline_bytes": max(0.0, total - shm),
+                "shm_frames": sum(
+                    v for k, v in ctr.items()
+                    if parse_metric_key(k)[0] == "comm.shm_frames"),
+                "shm_fallbacks": shm_fallbacks,
+                "delta_bcast_bytes": ctr.get("comm.delta_bcast_bytes", 0),
+                "delta_full_fallbacks": delta_fallbacks,
+                "delta_resyncs": ctr.get("comm.delta_resyncs", 0),
+            }
+
     # compression ratios: the comm.raw_bytes / comm.compressed_bytes
     # counter pair the compress subsystem records per message type
     compression = {}
@@ -234,6 +275,7 @@ def summarize(records: List[dict]) -> dict:
         "rounds": rounds,
         "spans": spans,
         "comm": comm,
+        "transport": transport,
         "compression": compression,
         "faults": faults,
         "fault_events": fault_events,
@@ -330,6 +372,23 @@ def render_text(path: str, s: dict, max_round_rows: int = 30) -> None:
                 f"{_fmt_s(lat.get('p50_le_s')):>10}"
                 f"{_fmt_s(lat.get('p99_le_s')):>10}"
             )
+
+    if s.get("transport"):
+        t = s["transport"]
+        print("\n  transport (shm lane / delta broadcast):")
+        share = t.get("shm_share")
+        print(f"    wire bytes {_fmt_bytes(t['wire_bytes_total']):>14}  "
+              f"shm {_fmt_bytes(t['shm_payload_bytes']):>14}"
+              + (f" ({share * 100:.1f}%)" if share is not None else "")
+              + f"  inline tcp {_fmt_bytes(t['tcp_inline_bytes']):>14}")
+        print(f"    shm frames {int(t.get('shm_frames', 0))}"
+              + (f"  fallbacks {t['shm_fallbacks']}"
+                 if t.get("shm_fallbacks") else ""))
+        if t.get("delta_bcast_bytes") or t.get("delta_full_fallbacks") \
+                or t.get("delta_resyncs"):
+            print(f"    delta bcast {_fmt_bytes(t['delta_bcast_bytes'])}"
+                  f"  full fallbacks {t.get('delta_full_fallbacks') or {}}"
+                  f"  resyncs {int(t.get('delta_resyncs', 0))}")
 
     if s.get("compression"):
         print("\n  compression (per message type):")

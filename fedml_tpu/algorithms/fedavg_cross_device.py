@@ -56,6 +56,7 @@ from fedml_tpu.core import tree as treelib
 MSG_ARG_KEY_CODEC = "codec"
 from fedml_tpu.core.client import LocalUpdateFn
 from fedml_tpu.core.types import FedDataset, pack_clients
+from fedml_tpu.obs import flight
 from fedml_tpu.obs.telemetry import get_telemetry
 
 SERVER = 0
@@ -927,16 +928,23 @@ class FedAvgServerManager(NodeManager):
 
     def _on_deadline(self, round_gen: int):
         try:
+            arrived = None
             with self._round_lock:
                 if round_gen != self.round_idx or self.round_idx >= self.comm_rounds:
                     return  # stale timer: that round already closed
+                arrived = len(self.pending)
                 if not self.pending:
                     # nobody arrived: the global model is unchanged, the
                     # round still closes (an all-dropped round under the
                     # mask semantics is a no-op update)
                     self._close_round(dropped_all=True)
-                    return
-                self._close_round()
+                else:
+                    self._close_round()
+            # black-box trigger outside the round lock (the dump does
+            # file IO): a deadline firing IS the overrun — the timer
+            # only exists while the round is still open
+            flight.trigger("deadline_overrun", round_idx=round_gen,
+                           reason=f"arrived={arrived}")
         except Exception:
             # a Timer-thread exception dies silently; without the
             # re-arm below the round would stay open forever (no later
@@ -1241,13 +1249,19 @@ class FedAvgServerManager(NodeManager):
         round stays open — the deadline/other reporters close it."""
         get_telemetry().inc("faults.observed", kind=kind,
                             msg_type=MSG_TYPE_C2S_SEND_MODEL)
+        flight.note("faults", "observed", what=kind, sender=sender)
         with self._round_lock:
             self.rejected_uploads += 1
+            round_idx = self.round_idx
             self.round_log.append(
-                {"round": self.round_idx, "rejected_from": sender,
+                {"round": round_idx, "rejected_from": sender,
                  "kind": kind}
             )
-            round_idx = self.round_idx
+        # dump outside the round lock (file IO): a corrupt/outlier/
+        # undecodable upload is exactly the moment the black box must
+        # preserve — the offending frame's metadata is still in the ring
+        flight.trigger("reject", round_idx=round_idx,
+                       reason=f"{kind} from node {sender}")
         logging.warning(
             "round %d: rejected %s from node %d (excluded from "
             "aggregation)", round_idx, kind, sender,
@@ -1608,6 +1622,12 @@ class FedAvgClientManager(NodeManager):
         ):
             import os
 
+            # the black box flushes on the way down (forced, bypassing
+            # the rate limit) — a REAL SIGKILL leaves only the
+            # faulthandler log, but this injected crash models a
+            # process that still gets its last instruction through
+            flight.trigger("crash", reason="crash_at_round",
+                           round_idx=self.crash_at_round, force=True)
             # os._exit: skip atexit/finally — the process dies exactly
             # like a SIGKILL'd one, mid-protocol, socket left dangling
             os._exit(137)

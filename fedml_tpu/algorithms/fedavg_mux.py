@@ -72,6 +72,7 @@ from fedml_tpu.algorithms.fedavg_cross_device import (
 from fedml_tpu.comm.mux import TcpMuxBackend
 from fedml_tpu.core.client import LocalUpdateFn
 from fedml_tpu.core.types import FedDataset, pack_clients
+from fedml_tpu.obs import flight
 
 
 class _VirtualEndpoint(NodeManager):
@@ -296,6 +297,10 @@ class FedAvgMuxClientManager:
         ):
             import os
 
+            # black-box flush on the way down (see the per-process
+            # client manager's twin of this path)
+            flight.trigger("crash", reason="crash_at_round",
+                           round_idx=self.crash_at_round, force=True)
             # the muxer-process twin of the client --crash-at-round
             # knob: os._exit skips cleanup, so HUNDREDS of virtual
             # clients vanish mid-protocol at once — the blast radius

@@ -45,6 +45,18 @@ _registry_lock = threading.Lock()
 _edges: Set[Tuple[str, str]] = set()  # (held lock name, acquired lock name)
 _held_local = threading.local()
 
+# single acquisition tap (the flight recorder's lock ring): called as
+# ``fn(lock_name, held_depth)`` after every successful CheckedLock
+# acquire.  Atomic ref swap, exceptions swallowed at the call site —
+# same contract as the telemetry taps.  Costs nothing with checking
+# off (plain Locks never reach it).
+_acquire_tap = None
+
+
+def set_acquire_tap(fn) -> None:
+    global _acquire_tap
+    _acquire_tap = fn
+
 
 class LockDisciplineError(RuntimeError):
     """A lock contract was violated at runtime (recursive acquire, or a
@@ -104,6 +116,12 @@ class CheckedLock:
         ok = self._lock.acquire(blocking, timeout)
         if ok:
             stack.append(self)
+            tap = _acquire_tap
+            if tap is not None:
+                try:
+                    tap(self.name, len(stack))
+                except Exception:
+                    pass
         return ok
 
     def release(self) -> None:

@@ -39,7 +39,7 @@ from typing import Callable, Dict, List, Optional
 from fedml_tpu.comm.backend import CommBackend
 from fedml_tpu.comm.message import Message
 from fedml_tpu.comm.tcp import TcpBackend
-from fedml_tpu.obs import trace_ctx
+from fedml_tpu.obs import flight, trace_ctx
 from fedml_tpu.obs.telemetry import get_telemetry
 
 
@@ -160,6 +160,10 @@ class TcpMuxBackend(TcpBackend):
         exactly once (the first delivery)."""
         tel = get_telemetry()
         tel.inc("comm.mux_frames", msg_type=msg.type)
+        # one flight record per PHYSICAL frame (not per local delivery):
+        # the black box keeps the fan-out shape without 500x ring churn
+        flight.note("comm", "mux_fanout", msg_type=msg.type,
+                    nbytes=nbytes or 0, n_nodes=len(nodes or ()))
         self._dispatch_flag.active = True
         try:
             first = True

@@ -57,6 +57,7 @@ import zlib
 from typing import Dict, List, Optional
 
 from fedml_tpu.analysis.locks import make_lock
+from fedml_tpu.obs import flight
 
 _MAGIC = b"FEDSHM13"
 _VERSION = 1
@@ -367,6 +368,10 @@ class ShmLane:
             return None
         if isinstance(out, str):
             self.last_refusal = out
+            # flight-recorder comm ring: each lane refusal with its
+            # reason and size — the forensics evidence that separates
+            # "ring sized too small" from "reader stopped draining"
+            flight.note("comm", "shm_refusal", reason=out, nbytes=nbytes)
             return None
         return out
 

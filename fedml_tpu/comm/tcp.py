@@ -55,7 +55,7 @@ from fedml_tpu.comm.shm import (
     ShmLaneError,
     split_frame_line,
 )
-from fedml_tpu.obs import trace_ctx
+from fedml_tpu.obs import flight, trace_ctx
 from fedml_tpu.obs.telemetry import get_telemetry
 
 _SENTINEL = {HUB_KEY: "stop"}
@@ -643,6 +643,7 @@ class TcpHub:
             pass  # peer vanished: fall through to cleanup
         finally:
             if st is not None:
+                lost: List[int] = []
                 with self._lock:
                     st.dead = True
                     # identity guard: a re-registered node may have
@@ -651,6 +652,22 @@ class TcpHub:
                     for nid in ids:
                         if self._conns.get(nid) is st:
                             self._conns.pop(nid, None)
+                            lost.append(nid)
+                if lost and self._running:
+                    # a live connection died while the hub is serving —
+                    # the black box dumps with the per-conn queue
+                    # gauges and hub_stats ring still warm.  A rebound
+                    # conn (ids already claimed elsewhere) is NOT a
+                    # death; ``lost`` is only the ids that went dark.
+                    flight.note("events", "conn_death", cid=st.cid,
+                                mux=st.mux, node_ids=sorted(lost)[:64],
+                                n_nodes=len(lost))
+                    flight.trigger(
+                        "conn_death",
+                        reason=f"hub conn cid={st.cid} died; lost "
+                               f"{len(lost)} node id(s) "
+                               f"{sorted(lost)[:8]}",
+                    )
             if lane is not None:
                 # detach AND unlink: a gracefully-stopping dialer
                 # unlinks its own slab too (double unlink is a caught
@@ -1927,6 +1944,16 @@ class TcpBackend(CommBackend):
 
                 if lost_at is None:
                     lost_at = _time.perf_counter()
+                    # first EOF of an outage (not the retry loop): the
+                    # dialer's black box captures its own view of the
+                    # death — hub restarts attribute from BOTH sides
+                    flight.note("events", "conn_death",
+                                node=self.node_id)
+                    flight.trigger(
+                        "conn_death",
+                        reason=f"node {self.node_id}: hub connection "
+                               f"lost ({retries} retries left)",
+                    )
                 _time.sleep(0.2)
                 try:
                     self._dial()  # re-register; hub swaps the live conn

@@ -175,6 +175,17 @@ def _node_metrics_logger(run_dir: str, tag):
     return MetricsLogger(run_dir=run_dir, filename=f"metrics-{tag}.jsonl")
 
 
+def _install_flight(run_dir: str, tag) -> None:
+    """Arm this process's flight recorder (``obs/flight.py``): dump
+    destination ``flight-<tag>.json`` beside the metrics files, SIGUSR2
+    snapshot handler, unhandled-exception hooks, and the faulthandler
+    crash log.  Recording itself is always on; without a run_dir the
+    triggers only mark history."""
+    from fedml_tpu.obs import flight
+
+    flight.install(run_dir or None, str(tag))
+
+
 def _start_event_flusher(mlog, interval: float = 1.0):
     """Periodically drain the telemetry event ring into this process's
     metrics file while the main thread is blocked in ``backend.run()``.
@@ -248,6 +259,7 @@ def run_hub(host: str, port: int, run_dir: str = "",
     signal.signal(signal.SIGTERM, _stop)
     signal.signal(signal.SIGINT, _stop)
     mlog = _node_metrics_logger(run_dir, "hub")
+    _install_flight(run_dir, "hub")
     last_sample = time.monotonic()
     try:
         while not stop["flag"]:
@@ -382,6 +394,7 @@ def run_server(args) -> None:
     # otherwise evict clock_sync + early trace_hop chains before the
     # exit-time drain (deque maxlen=4096)
     mlog = _node_metrics_logger(args.run_dir, "node0")
+    _install_flight(args.run_dir, "node0")
     stop_flusher = _start_event_flusher(mlog)
     server.start()
     backend.run()  # returns when finish() closes the socket
@@ -474,6 +487,7 @@ def run_client(args) -> None:
     # thread keeps the bounded event ring from evicting early chains
     # on long runs
     mlog = _node_metrics_logger(args.run_dir, f"node{args.node_id}")
+    _install_flight(args.run_dir, f"node{args.node_id}")
     stop_flusher = _start_event_flusher(mlog)
     reporter = _start_stats_reporter(args, backend, mgr,
                                      nodes=[args.node_id])
@@ -538,6 +552,7 @@ def run_muxer(args) -> None:
         rejoin_every_round=args.rejoin_every_round,
     )
     mlog = _node_metrics_logger(args.run_dir, f"mux{args.node_id}")
+    _install_flight(args.run_dir, f"mux{args.node_id}")
     if mlog is not None:
         # timeline grouping evidence: fed_timeline parks every virtual
         # client's track under this muxer's process

@@ -42,7 +42,7 @@ from fedml_tpu.analysis.locks import make_lock
 from fedml_tpu.comm.backend import CommBackend, Observer
 from fedml_tpu.comm.message import NDARRAY_KEY, WIRETREE_KEY, Message
 from fedml_tpu.faults.plan import FaultPlan
-from fedml_tpu.obs import trace_ctx
+from fedml_tpu.obs import flight, trace_ctx
 from fedml_tpu.obs.telemetry import get_telemetry
 
 
@@ -257,10 +257,21 @@ class ChaosBackend(CommBackend):
                 (direction, msg_type, seq,
                  tuple(a["action"] for a in acts) or ("deliver",))
             )
+        if acts:
+            # flight-recorder fault ring: only the decisions that DID
+            # something (deliver-only would drown the signal)
+            flight.note("faults", "decision", direction=direction,
+                        msg_type=msg_type, seq=seq, round=round_idx,
+                        actions=[a["action"] for a in acts])
         return seq, acts
 
     def _inject(self, action: str, msg_type: str) -> None:
         self.telemetry.inc("faults.injected", action=action, msg_type=msg_type)
+        flight.note("faults", "injected", action=action, msg_type=msg_type)
+        # one bundle per injecting process per rate-limit window: chaos
+        # scenarios come back with black-box evidence from BOTH sides
+        # (the injector here, the tolerance layer's observed triggers)
+        flight.trigger("chaos_fault", reason=action)
 
     def _stripe_fault(self, msg_type: str, sid, idx, chunk):
         """Per-stripe decision on the inner transport's reassembly path

@@ -28,6 +28,7 @@ from fedml_tpu.comm.message import (
     NDARRAY_KEY,
     WIRETREE_KEY,
 )
+from fedml_tpu.obs import flight
 from fedml_tpu.obs.telemetry import Telemetry, get_telemetry
 
 # base64 expansion of binary buffers on the wire — applies ONLY to
@@ -47,6 +48,10 @@ def record_send(msg_type: str, nbytes: Optional[int], seconds: Optional[float],
         t.inc("comm.sent_bytes", nbytes, msg_type=msg_type)
     if seconds is not None and seconds >= 0:
         t.observe("comm.send_latency_s", seconds, msg_type=msg_type)
+    # per-frame metadata for the flight recorder's comm ring — every
+    # transport (tcp/shm/mux/inproc) reports through here, so the black
+    # box sees each frame once regardless of how it traveled
+    flight.note("comm", "send", msg_type=msg_type, nbytes=nbytes or 0)
 
 
 def record_recv(msg_type: str, nbytes: Optional[int] = None,
@@ -55,6 +60,7 @@ def record_recv(msg_type: str, nbytes: Optional[int] = None,
     t.inc("comm.recv_msgs", 1, msg_type=msg_type)
     if nbytes:
         t.inc("comm.recv_bytes", nbytes, msg_type=msg_type)
+    flight.note("comm", "recv", msg_type=msg_type, nbytes=nbytes or 0)
 
 
 def record_handle(msg_type: str, seconds: float,
@@ -85,6 +91,8 @@ def record_unhandled(msg_type: str,
     t = telemetry or get_telemetry()
     t.inc("comm.unhandled_msgs", 1, msg_type=msg_type)
     t.inc("faults.observed", 1, kind="unhandled_msg", msg_type=msg_type)
+    flight.note("faults", "observed", what="unhandled_msg",
+                msg_type=msg_type)
 
 
 def _value_nbytes(v, binary: bool = True) -> float:

@@ -61,6 +61,9 @@ COUNTERS = {
     "robust.capped_conns": "connections rescaled by the contribution cap",
     "robust.cap_infeasible": "rounds where the conn cap was unsatisfiable (left unapplied, loudly)",
     "rounds.degraded": "rounds closed under the aggregation target",
+    "flight.dumps": "flight-recorder bundles written {trigger=}",
+    "flight.dumps_suppressed": "dumps skipped by the per-trigger rate limit or a dump already in flight {trigger=}",
+    "flight.dump_errors": "bundle writes that failed (fs errors; recording continues)",
     "jax.compiles": "jit compilations per instrumented fn {fn=}",
     "jax.backend_compile_events": "runtime jax.monitoring compile events {event=}",
 }
@@ -104,6 +107,7 @@ HISTOGRAMS = {
     "slo.round_bytes": "server-visible comm bytes folded per round (sent+recv delta)",
     "jax.compile_s": "wall time of compile-triggering calls {fn=}",
     "jax.backend_compile_s": "runtime-reported compile durations {event=}",
+    "flight.dump_write_s": "atomic flight-bundle write (snapshot + json + replace)",
 }
 
 # --- dynamic-name patterns ---------------------------------------------------
@@ -128,6 +132,7 @@ EVENTS = {
     "trace_hop": "full per-message hop chain (receiver-side emission)",
     "mux_members": "muxer membership {muxer, nodes} — timeline track grouping",
     "slo_violation": "one failed SLO objective {round, objective, observed, threshold}",
+    "flight_dump": "flight-recorder bundle written {trigger, reason, round, path, write_s}",
 }
 
 # flat view used by the linter and by tools that just need existence

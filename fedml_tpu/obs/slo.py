@@ -250,6 +250,15 @@ class SloEngine:
         with self._lock:
             self.rounds_evaluated += 1
             self.violations.extend(found)
+        if found:
+            # black-box trigger: the evaluation that flagged the round
+            # is the moment the evidence is still in the rings
+            from fedml_tpu.obs import flight
+
+            flight.trigger(
+                "slo_violation", round_idx=round_idx,
+                reason=",".join(v["objective"] for v in found),
+            )
         return found
 
     def coverage(self, rollup_digest: dict, sources: dict,

@@ -120,6 +120,26 @@ class Telemetry:
         self.gauges: Dict[str, float] = {}
         self.hists: Dict[str, Histogram] = {}
         self._events: deque = deque(maxlen=max_events)
+        # taps: single callbacks invoked OUTSIDE the lock after an
+        # event/observation lands — the flight recorder's feed
+        # (``obs/flight.py``).  Plain attribute swap (atomic ref), never
+        # guarded: readers see either the old tap or the new one.
+        self._event_tap = None
+        self._observe_tap = None
+
+    # -- taps ---------------------------------------------------------------
+    def set_event_tap(self, fn) -> None:
+        """Install the single event tap (``fn(record_dict)``), called
+        after every ``event()`` append, outside the registry lock.  A
+        tap exception is swallowed — observation must never break the
+        emitter.  ``None`` uninstalls."""
+        self._event_tap = fn
+
+    def set_observe_tap(self, fn) -> None:
+        """Install the single histogram tap (``fn(name, value, labels)``),
+        called after every accepted ``observe()``.  Same contract as the
+        event tap: outside the lock, exceptions swallowed."""
+        self._observe_tap = fn
 
     # -- counters -----------------------------------------------------------
     def inc(self, name: str, value: float = 1.0, **labels) -> None:
@@ -146,6 +166,12 @@ class Telemetry:
             if h is None:
                 h = self.hists[key] = Histogram()
             h.observe(value)
+        tap = self._observe_tap
+        if tap is not None:
+            try:
+                tap(name, value, labels)
+            except Exception:
+                pass  # the tap must never break the emitter
 
     # -- events -------------------------------------------------------------
     def event(self, kind: str, **fields) -> dict:
@@ -155,6 +181,12 @@ class Telemetry:
         rec = {"kind": kind, "ts": time.time(), **fields}
         with self._lock:
             self._events.append(rec)
+        tap = self._event_tap
+        if tap is not None:
+            try:
+                tap(rec)
+            except Exception:
+                pass  # the tap must never break the emitter
         return rec
 
     def drain_events(self) -> List[dict]:

@@ -13,6 +13,15 @@ JSON form on every run as a downloadable trajectory artifact.
     python tools/bench_trend.py                  # table over the repo root
     python tools/bench_trend.py --json           # machine-readable records
     python tools/bench_trend.py --metric p50     # filter headline keys
+    python tools/bench_trend.py --gate           # regression gate (exit 1)
+
+``--gate`` compares each family's NEWEST artifact against the same
+family's artifact from the prior round, within a per-family tolerance
+(``GATE_RULES``): latency/overhead metrics must not grow past it,
+accuracy/survival metrics must not shrink past it, ok-booleans must
+not flip false.  Nonzero exit on any regression — CI runs it warn-only
+(the artifacts are committed measurements, not re-runs; a flagged
+regression is a review prompt, not a build breaker).
 
 Stdlib-only (runs in the CI lint job's bare interpreter).
 """
@@ -29,7 +38,7 @@ import sys
 PREFIXES = (
     "BENCH_", "FEDLAT_", "FEDSCALE_", "FEDTRACE_", "FEDHEALTH_",
     "FAULTS_", "CONVERGENCE_", "COMPRESS_", "MULTICHIP_", "SCALING_",
-    "FEDERATION_", "ROBUST_", "FEDXPORT_", "FEDCHURN_",
+    "FEDERATION_", "ROBUST_", "FEDXPORT_", "FEDCHURN_", "FEDFLIGHT_",
 )
 
 _ROUND_RE = re.compile(r"[_-]r(\d+)")
@@ -178,6 +187,14 @@ def _extract(doc: dict, fname: str) -> dict:
         ok = _deep_get(doc, "churn.ok")
         if ok is not None:
             out["ok"] = bool(ok)
+    elif fname.startswith("FEDFLIGHT_"):
+        for k in ("p50_on", "p50_off", "overhead_ratio", "attributed"):
+            v = _num(_deep_get(doc, f"verdict.{k}"))
+            if v is not None:
+                out[k] = v
+        ok = _deep_get(doc, "verdict.ok")
+        if ok is not None:
+            out["ok"] = bool(ok)
     elif fname.startswith("FAULTS_"):
         scenarios = doc.get("scenarios")
         if isinstance(scenarios, list):
@@ -231,6 +248,82 @@ def collect(root: str):
     return records
 
 
+# --gate rules: family prefix -> (metric -> direction, tolerance).
+# Directions: "lower" (regression = grew past tol), "higher"
+# (regression = shrank past tol), "true" (regression = flipped falsy).
+# Metric names ending in "*" match by prefix (per-arm keys vary).
+# Only explicitly listed metrics gate — everything else is trend-only
+# (ambiguous direction must never fail a build by guesswork).
+GATE_RULES = {
+    "FEDLAT_": ({"p50[*": "lower"}, 0.15),
+    "FEDTRACE_": ({"p50[*": "lower"}, 0.15),
+    "FEDSCALE_": ({"scale_p50": "lower", "hub_rss_ratio": "lower"}, 0.15),
+    "FEDHEALTH_": ({"overhead_ratio": "lower", "ok": "true"}, 0.10),
+    "FEDXPORT_": ({"p50[*": "lower", "delta_bytes_ratio": "lower",
+                   "ok[*": "true"}, 0.15),
+    "FEDCHURN_": ({"hub_rss_mb": "lower", "ok": "true"}, 0.20),
+    "FAULTS_": ({"survived": "higher", "all_nan_free": "true"}, 0.0),
+    "ROBUST_": ({"defended_acc_at_30pct": "higher", "ok": "true"}, 0.05),
+    "CONVERGENCE_": ({"acc*": "higher"}, 0.05),
+    "COMPRESS_": ({"reduction_ratio": "lower"}, 0.10),
+    "FEDFLIGHT_": ({"overhead_ratio": "lower",
+                    "attributed": "higher", "ok": "true"}, 0.10),
+}
+
+
+def _rule_for(metric: str, rules: dict):
+    if metric in rules:
+        return rules[metric]
+    for pat, d in rules.items():
+        if pat.endswith("*") and metric.startswith(pat[:-1]):
+            return d
+    return None
+
+
+def gate(records):
+    """Newest artifact per family vs the SAME family's prior-round
+    artifact -> (failures, comparisons).  Families with fewer than two
+    rounds of history, unreadable artifacts, and unlisted metrics are
+    skipped, never failed."""
+    by_family = {}
+    for r in records:
+        if "error" in r or r.get("round") is None:
+            continue
+        fam = r.get("kind", "").upper() + "_"
+        # same round + family: the lexically last artifact wins (the
+        # sort in collect() already ordered them)
+        by_family.setdefault(fam, {})[r["round"]] = r
+    failures, comparisons = [], []
+    for fam, (rules, tol) in sorted(GATE_RULES.items()):
+        rounds = sorted(by_family.get(fam, {}))
+        if len(rounds) < 2:
+            continue
+        new = by_family[fam][rounds[-1]]
+        old = by_family[fam][rounds[-2]]
+        for metric, nv in sorted((new.get("metrics") or {}).items()):
+            direction = _rule_for(metric, rules)
+            ov = (old.get("metrics") or {}).get(metric)
+            if direction is None or ov is None:
+                continue
+            cmp = {"family": fam.rstrip("_"), "metric": metric,
+                   "old": ov, "new": nv, "tolerance": tol,
+                   "old_artifact": old["artifact"],
+                   "new_artifact": new["artifact"]}
+            if direction == "true":
+                bad = bool(ov) and not bool(nv)
+            elif direction == "lower":
+                bad = _num(nv) is not None and _num(ov) is not None \
+                    and nv > ov * (1 + tol) + 1e-12
+            else:  # "higher"
+                bad = _num(nv) is not None and _num(ov) is not None \
+                    and nv < ov * (1 - tol) - 1e-12
+            cmp["regressed"] = bad
+            comparisons.append(cmp)
+            if bad:
+                failures.append(cmp)
+    return failures, comparisons
+
+
 def _fmt_val(v):
     if isinstance(v, bool):
         return str(v)
@@ -268,11 +361,32 @@ def main(argv=None) -> int:
                    help="also write the JSON records to this path")
     p.add_argument("--metric", default="",
                    help="filter headline keys by substring (table mode)")
+    p.add_argument("--gate", action="store_true",
+                   help="newest-vs-prior-round regression gate; exit 1 "
+                        "on any per-family tolerance breach")
     args = p.parse_args(argv)
     records = collect(args.dir)
     if not records:
         print(f"no benchmark artifacts under {args.dir!r}", file=sys.stderr)
         return 2
+    if args.gate:
+        failures, comparisons = gate(records)
+        doc = {"compared": len(comparisons), "regressions": failures}
+        if args.out:
+            with open(args.out, "w") as fh:
+                json.dump(doc, fh, indent=1)
+        if args.json:
+            print(json.dumps(doc, indent=1))
+        else:
+            for c in comparisons:
+                mark = "REGRESSED" if c["regressed"] else "ok"
+                print(f"{mark:>9}  {c['family']:<12} {c['metric']:<28} "
+                      f"{_fmt_val(c['old'])} -> {_fmt_val(c['new'])} "
+                      f"(tol {c['tolerance']:.0%}, "
+                      f"{c['old_artifact']} -> {c['new_artifact']})")
+            print(f"{len(comparisons)} comparisons, "
+                  f"{len(failures)} regression(s)")
+        return 1 if failures else 0
     doc = {"artifacts": len(records), "records": records}
     if args.out:
         with open(args.out, "w") as fh:

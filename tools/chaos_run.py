@@ -73,6 +73,7 @@ Usage (CPU is fine — this is a protocol soak, not a perf benchmark):
 from __future__ import annotations
 
 import argparse
+import glob
 import json
 import os
 import sys
@@ -185,8 +186,15 @@ def _scenarios(round_timeout: float, num_clients: int = 3):
         "stripe_faults": {
             "chaos_plan": stripe_plan,
             "round_timeout": round_timeout,
-            # small stripes so even the tiny test model stripes
+            # 1 KiB stripes AND a model big enough to cross the
+            # threshold: the default 8-dim model's ~450 B sync payload
+            # never striped, so this scenario silently injected NOTHING
+            # from PR 9 through PR 13 (every FAULTS_r*.json shows
+            # degraded=0 and an empty fault-counter set) — caught by
+            # the r16 forensics pass when the bundle-only verdict came
+            # back "none".  8.2 KB model -> every sync is ~8 stripes.
             "stripe_kib": 1,
+            "input_dim": 1024,
         },
         # killing one muxer drops its WHOLE virtual cohort at once (in
         # production: hundreds of clients; here: half the federation —
@@ -304,6 +312,27 @@ def _final_model_eval(out_path: str, seed: int, num_clients: int,
     }
 
 
+def _forensics(run_dir: str) -> dict:
+    """Postmortem verdict over the scenario's flight-recorder bundles
+    (``tools/fed_forensics.py``) — the scenario record's evidence that
+    the black box alone names the injected fault."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        import fed_forensics
+
+        v = fed_forensics.analyze(run_dir)
+    except Exception as e:
+        return {"error": f"{type(e).__name__}: {e}"}
+    return {
+        "fault_kind": v.get("fault_kind"),
+        "fault_round": v.get("fault_round"),
+        "confidence": v.get("confidence"),
+        "clock_mode": v.get("clock_mode"),
+        "evidence": v.get("evidence"),
+        "bundle_errors": v.get("bundle_errors"),
+    }
+
+
 def run_scenario(name: str, kwargs: dict, *, num_clients: int, rounds: int,
                  seed: int, timeout: float, transport=None) -> dict:
     from fedml_tpu.experiments.distributed_fedavg import launch
@@ -318,8 +347,13 @@ def run_scenario(name: str, kwargs: dict, *, num_clients: int, rounds: int,
     )
     if kwargs.get("muxed_clients") == -1:
         kwargs = dict(kwargs, muxed_clients=(num_clients + 1) // 2)
-    if kwargs.get("run_dir") == "auto":
+    if not kwargs.get("run_dir") or kwargs.get("run_dir") == "auto":
+        # every scenario gets a run_dir now: the flight recorders in
+        # each child process dump their black-box bundles there, and
+        # the record below carries the forensics verdict built from
+        # them (telemetry_loss additionally reads slo_report.json back)
         kwargs = dict(kwargs, run_dir=os.path.dirname(out_path))
+    run_dir = kwargs["run_dir"]
     info: dict = {}
     t0 = time.time()
     print(f"== scenario {name} ==", flush=True)
@@ -332,6 +366,9 @@ def run_scenario(name: str, kwargs: dict, *, num_clients: int, rounds: int,
     except Exception as e:  # harness failure IS a scenario failure
         return {"scenario": name, "survived": False,
                 "error": f"{type(e).__name__}: {e}",
+                "flight_bundles": sorted(
+                    glob.glob(os.path.join(run_dir, "flight-*.json"))),
+                "forensics": _forensics(run_dir),
                 "wall_s": round(time.time() - t0, 1)}
     rec = {
         "scenario": name,
@@ -346,6 +383,9 @@ def run_scenario(name: str, kwargs: dict, *, num_clients: int, rounds: int,
         "stats_plane": info.get("stats_plane") or {},
         "wall_s": round(time.time() - t0, 1),
     }
+    rec["flight_bundles"] = sorted(
+        glob.glob(os.path.join(run_dir, "flight-*.json")))
+    rec["forensics"] = _forensics(run_dir)
     report_path = os.path.join(os.path.dirname(out_path), "slo_report.json")
     if kwargs.get("run_dir") and os.path.exists(report_path):
         # telemetry-loss evidence: the SLO report must NAME the node(s)

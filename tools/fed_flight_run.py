@@ -1,0 +1,360 @@
+#!/usr/bin/env python
+"""FEDFLIGHT campaign: black-box recorder + postmortem forensics →
+``FEDFLIGHT_r16.json``.
+
+Two pre-declared bars (ISSUE 16 acceptance):
+
+1. **Overhead** — the always-on flight recorder may not cost more than
+   3% p50 round wall at the FEDLAT 32-client regime (32 virtual
+   clients on muxer processes).  A/B arms differ ONLY in the
+   ``FEDML_TPU_FLIGHT`` kill switch (both arms get a run_dir, so the
+   metrics writer and telemetry plane are identical); ABBA-interleaved
+   reps, verdict = median of per-rep p50s — the PR-6/PR-11 protocol.
+2. **Attribution** — the full 13-scenario chaos matrix from
+   ``tools/chaos_run.py`` runs with per-scenario run_dirs; every
+   scenario's verdict comes from ``tools/fed_forensics.py`` reading
+   the flight bundles ALONE (no live observation).  ≥11/13 scenarios
+   must be attributed to the injected fault kind — and, where the
+   injection round is determinate (crash-at-round, deterministic
+   per-frame rules), the round too.  The 13/13 NaN-free soak and
+   all-survived gates from the FAULTS campaign stay in force.
+
+Usage:
+    python tools/fed_flight_run.py --out FEDFLIGHT_r16.json
+    python tools/fed_flight_run.py --skip-overhead   # chaos matrix only
+    python tools/fed_flight_run.py --skip-chaos      # A/B only
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.fed_scale_run import _barrier, run_scale_federation  # noqa: E402
+from tools.trace_summary import percentile  # noqa: E402
+
+# scenario -> (expected fault kind, expected round or None).
+# Round is asserted only where the injection pins it a priori:
+# crash-at-round scenarios and deterministic first-round rules.
+# Wall-clock-triggered (hub_restart), detection-latency-dependent
+# (telemetry_loss) and roundless-evidence (shm_ring_full) scenarios
+# score on kind alone.
+EXPECTED = {
+    "fault_free": ("none", None),
+    "client_crash": ("client_crash", 1),
+    "hub_restart": ("hub_restart", None),
+    "drop30": ("message_drop", 0),
+    "straggler_deadline": ("straggler", 0),
+    "corrupt_payload": ("corrupt_upload", 0),
+    # sync-stripe injections land on the round boundary (the broadcast
+    # that closes round k opens k+1), so the first-decision round is
+    # legitimately either side of it — kind-only
+    "stripe_faults": ("stripe_fault", None),
+    "muxer_crash": ("muxer_crash", 1),
+    "telemetry_loss": ("telemetry_loss", None),
+    "malicious_client": ("malicious_client", 0),
+    "malicious_muxer": ("malicious_muxer", 0),
+    "shm_ring_full": ("shm_ring_full", None),
+    "shm_peer_crash": ("shm_peer_crash", 1),
+}
+
+
+def overhead_arm(tag: str, args, flight_on: bool) -> dict:
+    _barrier()
+    print(f"== {tag}: {args.clients} virtual clients on {args.muxers} "
+          f"muxers, flight recorder {'ON' if flight_on else 'OFF'} ==",
+          flush=True)
+    run_dir = tempfile.mkdtemp(prefix="fedflight_")
+    # the ONLY difference between arms: the env kill switch the child
+    # processes read at recorder install time (run_scale_federation
+    # inherits os.environ)
+    prev = os.environ.pop("FEDML_TPU_FLIGHT", None)
+    if not flight_on:
+        os.environ["FEDML_TPU_FLIGHT"] = "0"
+    try:
+        rec = run_scale_federation(
+            args.clients, args.muxers, args.rounds, seed=args.seed,
+            batch_size=args.batch_size, round_timeout=args.round_timeout,
+            timeout=args.timeout, run_dir=run_dir,
+            extra_flags=["--input-dim", str(args.input_dim),
+                         "--train-samples", str(args.train_samples)])
+    finally:
+        os.environ.pop("FEDML_TPU_FLIGHT", None)
+        if prev is not None:
+            os.environ["FEDML_TPU_FLIGHT"] = prev
+    rec["tag"] = tag
+    rec["run_dir"] = run_dir
+    bundles = sorted(glob.glob(os.path.join(run_dir, "flight-*.json")))
+    rec["flight_bundles"] = len(bundles)
+    rec["flight_bundle_bytes"] = sum(os.path.getsize(b) for b in bundles)
+    print(json.dumps({k: rec[k] for k in
+                      ("tag", "rc", "rounds", "nan_free", "wall_s",
+                       "round_wall_s", "flight_bundles")}), flush=True)
+    return rec
+
+
+def run_overhead(args) -> dict:
+    on_runs, off_runs = [], []
+    for rep in range(args.reps):
+        # ABBA: adjacent pairs share box state so slow drift cancels
+        order = [True, False] if rep % 2 == 0 else [False, True]
+        for flight_on in order:
+            on_off = "on" if flight_on else "off"
+            (on_runs if flight_on else off_runs).append(
+                overhead_arm(f"{on_off}_r{rep}", args, flight_on))
+
+    def med_p50(runs):
+        return percentile(
+            [r["round_wall_s"]["p50"] for r in runs
+             if r["round_wall_s"]["p50"] is not None], 0.5)
+
+    p50_on, p50_off = med_p50(on_runs), med_p50(off_runs)
+    overhead = (p50_on / p50_off) if (p50_on and p50_off) else None
+    return {
+        "regime": {"clients": args.clients, "muxers": args.muxers,
+                   "rounds": args.rounds, "reps": args.reps,
+                   "input_dim": args.input_dim,
+                   "model_mb": round((args.input_dim * 2 + 2) * 4 / 1e6, 2),
+                   "train_samples": args.train_samples,
+                   "protocol": "ABBA interleaved, both arms run_dir'd, "
+                               "OFF arm = FEDML_TPU_FLIGHT=0 env only; "
+                               "verdict = median of per-rep p50s"},
+        "arms": {"flight_on": on_runs, "flight_off": off_runs},
+        "p50_on": p50_on,
+        "p50_off": p50_off,
+        "overhead_ratio": (round(overhead, 4)
+                           if overhead is not None else None),
+        # ON arms must also actually leave black boxes behind (the
+        # atexit shutdown dump) — an OFF-equivalent recorder that's
+        # "fast" because it never writes is not the thing under test
+        "on_arm_bundles": [r["flight_bundles"] for r in on_runs],
+        "complete_nan_free": all(
+            r["rc"] == 0 and r["nan_free"] and r["rounds"] >= args.rounds
+            for r in on_runs + off_runs),
+    }
+
+
+def run_bundle_write(args) -> dict:
+    """Bundle-write bar at the 10k-virtual FEDSCALE point: a dump may
+    not cost more than one round wall.  Mid-run SIGUSR2s make every
+    process dump with warm rings; the exact write time lands in each
+    process's ``flight.dump_write_s`` histogram (``max`` field), which
+    the NEXT dump — the atexit shutdown bundle — carries out."""
+    import subprocess
+    import threading
+
+    _barrier()
+    print(f"== bundle_write: {args.bw_clients} virtual clients on "
+          f"{args.bw_muxers} muxers ==", flush=True)
+    run_dir = tempfile.mkdtemp(prefix="fedflight10k_")
+
+    def _usr2_later():
+        # two chances to land mid-run (setup time varies at 10k);
+        # dumps 10 s apart clear the per-trigger rate limit
+        for delay in (10.0, 20.0):
+            time.sleep(delay)
+            subprocess.run(
+                ["pkill", "-USR2", "-f",
+                 "fedml_tpu.experiments.distributed_fedavg"],
+                check=False)
+
+    threading.Thread(target=_usr2_later, daemon=True).start()
+    rec = run_scale_federation(
+        args.bw_clients, args.bw_muxers, args.bw_rounds, seed=args.seed,
+        batch_size=args.batch_size, round_timeout=args.round_timeout,
+        timeout=args.timeout, run_dir=run_dir)
+    writes = {}
+    for path in sorted(glob.glob(os.path.join(run_dir, "flight-*.json"))):
+        try:
+            with open(path) as fh:
+                bundle = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            continue
+        hist = ((bundle.get("telemetry") or {}).get("hists") or {}).get(
+            "flight.dump_write_s")
+        if hist and hist.get("max") is not None:
+            writes[bundle.get("node", os.path.basename(path))] = hist["max"]
+    p50 = rec["round_wall_s"]["p50"]
+    max_write = max(writes.values()) if writes else None
+    out = {
+        "regime": {"clients": args.bw_clients, "muxers": args.bw_muxers,
+                   "rounds": args.bw_rounds},
+        "rc": rec["rc"],
+        "nan_free": rec["nan_free"],
+        "p50_round_wall_s": p50,
+        "dump_write_s_by_node": writes,
+        "max_dump_write_s": max_write,
+        "ok": (max_write is not None and p50 is not None
+               and max_write <= p50),
+    }
+    print(json.dumps({"bundle_write": out}), flush=True)
+    return out
+
+
+def run_chaos_matrix(args) -> dict:
+    from tools.chaos_run import _scenarios, run_scenario
+
+    scenarios = _scenarios(args.chaos_round_timeout, args.chaos_clients)
+    rows = []
+    for name, kwargs in scenarios.items():
+        rec = run_scenario(
+            name, kwargs, num_clients=args.chaos_clients,
+            rounds=args.chaos_rounds, seed=args.seed,
+            timeout=args.chaos_timeout)
+        exp_kind, exp_round = EXPECTED.get(name, (None, None))
+        forensics = rec.get("forensics") or {}
+        got_kind = forensics.get("fault_kind")
+        got_round = forensics.get("fault_round")
+        kind_ok = got_kind == exp_kind
+        round_ok = exp_round is None or got_round == exp_round
+        rows.append({
+            "scenario": name,
+            "expected_kind": exp_kind,
+            "expected_round": exp_round,
+            "got_kind": got_kind,
+            "got_round": got_round,
+            "confidence": forensics.get("confidence"),
+            "clock_mode": forensics.get("clock_mode"),
+            "kind_ok": kind_ok,
+            "round_ok": round_ok,
+            "attributed": kind_ok and round_ok,
+            "bundles": len(rec.get("flight_bundles") or []),
+            "survived": bool(rec.get("survived")),
+            "nan_free": bool(rec.get("nan_free", False)),
+            "wall_s": rec.get("wall_s"),
+            "forensics_error": forensics.get("error"),
+        })
+        print(json.dumps(rows[-1]), flush=True)
+    return {
+        "config": {"num_clients": args.chaos_clients,
+                   "rounds": args.chaos_rounds,
+                   "round_timeout_s": args.chaos_round_timeout,
+                   "seed": args.seed},
+        "matrix": rows,
+        "attributed": sum(1 for r in rows if r["attributed"]),
+        "kind_matched": sum(1 for r in rows if r["kind_ok"]),
+        "total": len(rows),
+        "all_survived": all(r["survived"] for r in rows),
+        "all_nan_free": all(r["nan_free"] for r in rows),
+        "bundles_every_scenario": all(r["bundles"] > 0 for r in rows),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="FEDFLIGHT_r16.json")
+    p.add_argument("--clients", type=int, default=32)
+    p.add_argument("--muxers", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=7)
+    # reps=3 (not the FEDHEALTH campaign's 2): this box shows a rare
+    # 2x round-wall mode that lands on whole runs — a median of three
+    # per-rep p50s absorbs one such outlier run per arm, two cannot
+    p.add_argument("--reps", type=int, default=3,
+                   help="ABBA-interleaved reps per arm")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--batch-size", type=int, default=16)
+    # the FEDLAT regime (FEDLAT_r09/FEDXPORT_r13): ~1.05 MB model,
+    # comm-dominant rounds — small enough boxes time-slice it, large
+    # enough that a 3% p50 bar measures the recorder, not the scheduler
+    p.add_argument("--input-dim", type=int, default=131072)
+    p.add_argument("--train-samples", type=int, default=16)
+    p.add_argument("--round-timeout", type=float, default=600.0)
+    p.add_argument("--timeout", type=float, default=3600.0)
+    p.add_argument("--chaos-clients", type=int, default=3)
+    p.add_argument("--chaos-rounds", type=int, default=3)
+    p.add_argument("--chaos-round-timeout", type=float, default=20.0)
+    p.add_argument("--chaos-timeout", type=float, default=240.0)
+    p.add_argument("--bw-clients", type=int, default=10000)
+    p.add_argument("--bw-muxers", type=int, default=4)
+    p.add_argument("--bw-rounds", type=int, default=3)
+    p.add_argument("--skip-overhead", action="store_true")
+    p.add_argument("--skip-chaos", action="store_true")
+    p.add_argument("--skip-bundle-write", action="store_true")
+    args = p.parse_args(argv)
+
+    # partial re-runs (the fed_xport_run idiom): a skipped phase reuses
+    # the section already in --out instead of erasing it
+    prev = {}
+    if os.path.exists(args.out):
+        try:
+            with open(args.out) as fh:
+                prev = json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            prev = {}
+
+    overhead = (prev.get("overhead") if args.skip_overhead
+                else run_overhead(args))
+    bundle_write = (prev.get("bundle_write") if args.skip_bundle_write
+                    else run_bundle_write(args))
+    chaos = prev.get("chaos") if args.skip_chaos else run_chaos_matrix(args)
+
+    checks = {}
+    if overhead is not None:
+        # one-sided bar (the PR-6 tracing convention): the ON arm may
+        # not be >3% SLOWER; faster is box noise in the recorder's favor
+        checks["overhead_within_3pct"] = (
+            overhead["overhead_ratio"] is not None
+            and overhead["overhead_ratio"] <= 1.03)
+        checks["overhead_arms_complete_nan_free"] = \
+            overhead["complete_nan_free"]
+        checks["on_arms_left_bundles"] = all(
+            n > 0 for n in overhead["on_arm_bundles"])
+    if bundle_write is not None:
+        checks["bundle_write_leq_one_round_wall_10k"] = bundle_write["ok"]
+    if chaos is not None:
+        checks["attributed_at_least_11_of_13"] = (
+            chaos["attributed"] >= 11 and chaos["total"] >= 13)
+        checks["all_nan_free"] = chaos["all_nan_free"]
+        checks["all_survived"] = chaos["all_survived"]
+        checks["bundles_every_scenario"] = chaos["bundles_every_scenario"]
+
+    verdict = {
+        "p50_on": overhead["p50_on"] if overhead else None,
+        "p50_off": overhead["p50_off"] if overhead else None,
+        "overhead_ratio": overhead["overhead_ratio"] if overhead else None,
+        "max_dump_write_s": (bundle_write["max_dump_write_s"]
+                             if bundle_write else None),
+        "attributed": chaos["attributed"] if chaos else None,
+        "kind_matched": chaos["kind_matched"] if chaos else None,
+        "total": chaos["total"] if chaos else None,
+        "checks": checks,
+        "ok": bool(checks) and all(bool(v) for v in checks.values()),
+    }
+    artifact = {
+        "experiment": (
+            "flight recorder + postmortem forensics: always-on black-box "
+            "overhead A/B at the FEDLAT 32-client muxed regime (arms "
+            "differ only in the FEDML_TPU_FLIGHT kill switch), and "
+            "bundle-only fault attribution over the 13-scenario chaos "
+            "matrix via tools/fed_forensics.py"
+        ),
+        "generated_unix": round(time.time(), 1),
+        "overhead": overhead,
+        "bundle_write": bundle_write,
+        "chaos": chaos,
+        "thresholds_pre_declared": {
+            "overhead_p50_max": 1.03,
+            "bundle_write_max": "one p50 round wall at the 10k-virtual "
+                                "FEDSCALE point (mid-run SIGUSR2 dumps)",
+            "attribution_min": "11/13 correct fault kind (+round where "
+                               "the injection pins it)",
+            "soak": "13/13 NaN-free, all survived, every scenario "
+                    "leaves >=1 flight bundle",
+        },
+        "verdict": verdict,
+    }
+    with open(args.out, "w") as fh:
+        json.dump(artifact, fh, indent=1, default=float)
+    print(json.dumps({"out": args.out, "verdict": verdict}, default=float))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,671 @@
+#!/usr/bin/env python
+"""Automated postmortem over a run_dir's flight-recorder bundles.
+
+Input: the ``flight-<node>.json`` black boxes that
+``fedml_tpu/obs/flight.py`` dumps into a run_dir — one per process
+(hub, server ``node0``, clients ``node<id>``, muxers ``mux<id>``),
+written on trigger (crash, deadline overrun, reject, conn death, chaos
+fault, SLO violation, ...) and flushed once more at clean exit.  No
+metrics files, no tracing, no live processes required: the verdict is
+built from what each process's own rings recorded before it died.
+
+Pipeline:
+
+1. **Merge onto one clock.**  Every bundle pins its dial-time
+   ``clock_sync`` offset estimate — the SAME min-RTT estimate
+   ``tools/fed_timeline.py`` uses (``t_hub = t_local + offset_s``).
+   When every bundle has one, all stamps are mapped onto the hub's
+   monotonic clock exactly like the timeline merges metrics files;
+   otherwise the merge falls back to the wall clock through each
+   bundle's own ``(t_m_dump, t_wall_dump)`` anchor (ms-level, plenty
+   for round-scale forensics — and immune to hub restarts resetting
+   the monotonic origin).
+
+2. **Locate the rounds.**  The server bundle's ``round_close`` events
+   carry ``t_open_m``/``t_close_m``; mapped onto the shared clock they
+   give per-round intervals every other bundle's evidence is bucketed
+   into.
+
+3. **Attribute the fault.**  An ordered decision tree over the merged
+   evidence — explicit crash dumps beat chaos-injection records beat
+   inferred signatures (reconnect storms, every-frame shm fallbacks,
+   repeated deadline overruns) beat server-side tolerance observations
+   — names a fault kind, the round it hit, and the evidence chain.
+
+4. **Diff the anomalous round** against the nearest healthy one:
+   span medians (decode waits, fold stalls, round walls —
+   ``fed_timeline.percentile``, same estimator), hub queue samples,
+   comm bytes/frames, fallback + fault counts.
+
+Output: a machine-readable verdict JSON (stdout and/or ``--out``) and
+optionally a Perfetto/Chrome trace-event export of the final recorded
+window (``--perfetto``): one process track per bundle, one thread per
+ring category, an instant event per ring entry plus trigger markers.
+
+Usage:
+
+    python tools/fed_forensics.py <run_dir> --out verdict.json
+    python tools/fed_forensics.py <run_dir> --perfetto flight.trace.json
+
+``tools/chaos_run.py`` runs this automatically per scenario and
+attaches the verdict to each scenario record.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import fed_timeline  # noqa: E402  (shared percentile + offset conventions)
+
+SCHEMA = 1
+
+# chaos-layer action names (faults/chaos.py ``_inject``) -> fault kind
+STRIPE_ACTIONS = ("drop_stripe", "corrupt_stripe")
+BYZANTINE_ACTIONS = ("sign_flip", "scale_grad")
+TELEMETRY_MSG_TYPES = ("C2S_TELEMETRY",)
+
+
+def parse_metric_key(key: str):
+    """``name{k=v,...}`` -> (name, labels) — mirror of
+    ``obs.telemetry.parse_metric_key`` (this tool must run on a bare
+    interpreter with no fedml_tpu import)."""
+    if not key.endswith("}") or "{" not in key:
+        return key, {}
+    name, _, inner = key.partition("{")
+    labels = {}
+    for part in inner[:-1].split(","):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return name, labels
+
+
+# -- loading ----------------------------------------------------------------
+
+def load_bundles(run_dir: str) -> Tuple[Dict[str, dict], Dict[str, str]]:
+    """tag -> bundle for every parseable flight-*.json; unparseable
+    files (a process killed mid-``os.replace`` cannot produce one, but
+    a truncated copy can) are reported, never fatal."""
+    bundles: Dict[str, dict] = {}
+    errors: Dict[str, str] = {}
+    for path in sorted(glob.glob(os.path.join(run_dir, "flight-*.json"))):
+        tag = os.path.basename(path)[len("flight-"):-len(".json")]
+        try:
+            with open(path) as fh:
+                b = json.load(fh)
+            if b.get("schema") != SCHEMA:
+                raise ValueError(f"unknown bundle schema {b.get('schema')}")
+            b["_path"] = path
+            bundles[tag] = b
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            errors[path] = f"{type(e).__name__}: {e}"
+    return bundles, errors
+
+
+class Clock:
+    """Map each bundle's local monotonic stamps onto ONE shared axis
+    (see module doc, step 1)."""
+
+    def __init__(self, bundles: Dict[str, dict]):
+        self.offsets: Dict[str, float] = {}
+        have_all = True
+        for tag, b in bundles.items():
+            cs = b.get("clock_sync") or {}
+            off = cs.get("offset_s")
+            if tag == "hub":
+                self.offsets[tag] = 0.0
+            elif off is not None:
+                self.offsets[tag] = float(off)
+            else:
+                have_all = False
+        self.mode = "hub_monotonic" if (have_all and bundles) else "wall"
+        self._anchors = {
+            tag: (float(b.get("t_m_dump") or 0.0),
+                  float(b.get("t_wall_dump") or 0.0))
+            for tag, b in bundles.items()
+        }
+
+    def t(self, tag: str, t_m) -> Optional[float]:
+        """One bundle-local monotonic stamp -> shared axis."""
+        if t_m is None:
+            return None
+        t_m = float(t_m)
+        if self.mode == "hub_monotonic":
+            return t_m + self.offsets.get(tag, 0.0)
+        t_m_dump, t_wall_dump = self._anchors.get(tag, (0.0, 0.0))
+        return t_wall_dump - (t_m_dump - t_m)
+
+
+# -- rounds -----------------------------------------------------------------
+
+def round_intervals(bundles: Dict[str, dict],
+                    clock: Clock) -> List[dict]:
+    """[{round, t_open, t_close}] on the shared clock, from the first
+    bundle carrying ``round_close`` events (the server's, normally)."""
+    for tag in (["node0"] + sorted(bundles)):
+        b = bundles.get(tag)
+        if b is None:
+            continue
+        rows = [r for r in (b.get("rings") or {}).get("events", ())
+                if r.get("kind") == "round_close" and r.get("round")
+                is not None]
+        if not rows:
+            continue
+        out = []
+        for r in sorted(rows, key=lambda r: r["round"]):
+            out.append({
+                "round": int(r["round"]),
+                "t_open": clock.t(tag, r.get("t_open_m")),
+                "t_close": clock.t(tag, r.get("t_close_m", r.get("t_m"))),
+            })
+        return out
+    return []
+
+
+def locate_round(t: Optional[float], intervals: List[dict],
+                 slack_s: float = 0.5) -> Optional[int]:
+    """Which round was active at shared-clock time ``t``: containment
+    first (with slack for queue/wire latency ahead of the open stamp),
+    else nearest interval midpoint."""
+    if t is None or not intervals:
+        return None
+    for iv in intervals:
+        lo = iv["t_open"] - slack_s if iv["t_open"] is not None else None
+        hi = iv["t_close"] + slack_s if iv["t_close"] is not None else None
+        if lo is not None and hi is not None and lo <= t <= hi:
+            return iv["round"]
+    best, best_d = None, None
+    for iv in intervals:
+        pts = [p for p in (iv["t_open"], iv["t_close"]) if p is not None]
+        if not pts:
+            continue
+        d = min(abs(t - p) for p in pts)
+        if best_d is None or d < best_d:
+            best, best_d = iv["round"], d
+    return best
+
+
+# -- evidence ---------------------------------------------------------------
+
+def _counters(bundle: dict) -> Dict[str, float]:
+    return (bundle.get("telemetry") or {}).get("counters") or {}
+
+
+def collect_evidence(bundles: Dict[str, dict], clock: Clock) -> dict:
+    """Flatten every bundle's triggers, fault-ring records, and
+    headline counters into one evidence pool."""
+    ev = {
+        "crashes": [],            # {tag, round, reason, t}
+        "exceptions": [],         # {tag, reason, t}
+        "conn_deaths": [],        # {tag, reason, t}
+        "deadline_overruns": [],  # {tag, round, reason, t}
+        "rejects": [],            # {tag, round, reason, what, t}
+        "slo_violations": [],     # {tag, round, reason, t}
+        "decisions": [],          # {tag, direction, msg_type, round,
+                                  #  actions, t}
+        "injections": {},         # action -> {count, msg_types, tags,
+                                  #  first_t, first_round}
+        "shm_refusals": [],       # {tag, reason, t}
+        "reconnects": 0.0,
+        "shm_frames": defaultdict(float),     # tag -> frames sent
+        "shm_fallbacks": defaultdict(float),  # reason -> count
+        "capped_conns": 0.0,
+    }
+    trig_dst = {"crash": "crashes", "exception": "exceptions",
+                "conn_death": "conn_deaths",
+                "deadline_overrun": "deadline_overruns",
+                "reject": "rejects", "slo_violation": "slo_violations"}
+    for tag, b in bundles.items():
+        for rec in b.get("history") or ():
+            dst = trig_dst.get(rec.get("kind"))
+            if dst is None:
+                continue
+            ev[dst].append({"tag": tag, "round": rec.get("round"),
+                            "reason": rec.get("reason"),
+                            "t": clock.t(tag, rec.get("t_m"))})
+        rings = b.get("rings") or {}
+        for row in rings.get("faults", ()):
+            k = row.get("kind")
+            t = clock.t(tag, row.get("t_m"))
+            if k == "decision":
+                ev["decisions"].append({
+                    "tag": tag, "direction": row.get("direction"),
+                    "msg_type": row.get("msg_type"),
+                    "round": row.get("round"),
+                    "actions": row.get("actions") or [], "t": t})
+            elif k == "observed" and row.get("what"):
+                ev["rejects"].append({"tag": tag, "round": None,
+                                      "reason": None,
+                                      "what": row.get("what"), "t": t})
+        for row in rings.get("comm", ()):
+            if row.get("kind") == "shm_refusal":
+                ev["shm_refusals"].append({
+                    "tag": tag, "reason": row.get("reason"),
+                    "t": clock.t(tag, row.get("t_m"))})
+        for key, val in _counters(b).items():
+            name, labels = parse_metric_key(key)
+            if name == "faults.injected":
+                a = labels.get("action", "?")
+                slot = ev["injections"].setdefault(
+                    a, {"count": 0.0, "msg_types": set(), "tags": set(),
+                        "first_t": None, "first_round": None})
+                slot["count"] += val
+                if labels.get("msg_type"):
+                    slot["msg_types"].add(labels["msg_type"])
+                slot["tags"].add(tag)
+            elif name == "comm.reconnects":
+                ev["reconnects"] += val
+            elif name == "comm.shm_frames":
+                ev["shm_frames"][tag] += val
+            elif name == "comm.shm_fallbacks":
+                ev["shm_fallbacks"][labels.get("reason", "?")] += val
+            elif name == "robust.capped_conns":
+                ev["capped_conns"] += val
+    # stamp each injected action's first sighting from the fault rings
+    for d in ev["decisions"]:
+        for a in d["actions"]:
+            # ring decisions use the plan action name; stripe decisions
+            # surface in counters as drop_stripe/corrupt_stripe
+            keys = [a] if a in ev["injections"] else \
+                [f"{a}_stripe"] if f"{a}_stripe" in ev["injections"] else []
+            for key in keys:
+                slot = ev["injections"][key]
+                if slot["first_t"] is None or (d["t"] is not None
+                                               and d["t"] < slot["first_t"]):
+                    slot["first_t"] = d["t"]
+                if d["round"] is not None and (
+                        slot["first_round"] is None
+                        or d["round"] < slot["first_round"]):
+                    slot["first_round"] = d["round"]
+    return ev
+
+
+# -- attribution ------------------------------------------------------------
+
+def _first(rows: List[dict]) -> dict:
+    known = [r for r in rows if r.get("t") is not None]
+    return min(known, key=lambda r: r["t"]) if known else rows[0]
+
+
+def _inj_round(slot: dict, intervals: List[dict]) -> Optional[int]:
+    if slot.get("first_round") is not None:
+        return int(slot["first_round"])
+    return locate_round(slot.get("first_t"), intervals)
+
+
+def attribute(bundles: Dict[str, dict], clock: Clock,
+              intervals: List[dict], ev: dict) -> dict:
+    """Ordered decision tree -> {fault_kind, fault_round, confidence,
+    evidence: [...]}.  Explicit beats injected beats inferred."""
+
+    def verdict(kind, rnd, conf, evidence):
+        return {"fault_kind": kind, "fault_round": rnd,
+                "confidence": conf, "evidence": evidence}
+
+    # 1. a process dumped a crash bundle on its way down
+    if ev["crashes"]:
+        c = _first(ev["crashes"])
+        tag = c["tag"]
+        if tag.startswith("mux"):
+            shm = ev["shm_frames"].get(tag, 0.0) or any(
+                r["tag"] == tag for r in ev["shm_refusals"])
+            kind = "shm_peer_crash" if shm else "muxer_crash"
+        elif tag.startswith("node") and tag != "node0":
+            kind = "client_crash"
+        else:
+            kind = "crash"
+        rnd = c.get("round")
+        if rnd is None:
+            rnd = locate_round(c.get("t"), intervals)
+        return verdict(kind, rnd, "high", [
+            {"source": tag, "kind": "crash_trigger",
+             "reason": c.get("reason"), "round": c.get("round")}])
+
+    # 2. chaos-layer injections recorded by the injecting process
+    inj = ev["injections"]
+    if inj:
+        def ivd(action):
+            slot = inj[action]
+            return {"source": sorted(slot["tags"]),
+                    "kind": "faults.injected", "action": action,
+                    "count": slot["count"],
+                    "msg_types": sorted(slot["msg_types"])}
+
+        stripe = [a for a in STRIPE_ACTIONS if a in inj]
+        if stripe:
+            rnd = _inj_round(inj[stripe[0]], intervals)
+            return verdict("stripe_fault", rnd, "high",
+                           [ivd(a) for a in stripe])
+        byz = [a for a in BYZANTINE_ACTIONS if a in inj]
+        if byz:
+            a = byz[0]
+            from_mux = any(t.startswith("mux") for t in inj[a]["tags"])
+            kind = "malicious_muxer" if from_mux else "malicious_client"
+            extra = []
+            if ev["capped_conns"]:
+                extra.append({"source": "server", "kind": "counter",
+                              "name": "robust.capped_conns",
+                              "count": ev["capped_conns"]})
+            return verdict(kind, _inj_round(inj[a], intervals), "high",
+                           [ivd(x) for x in byz] + extra)
+        if "corrupt" in inj:
+            rnd = _inj_round(inj["corrupt"], intervals)
+            if rnd is None:
+                served = [r for r in ev["rejects"]
+                          if r.get("round") is not None]
+                rnd = min(r["round"] for r in served) if served else None
+            return verdict("corrupt_upload", rnd, "high",
+                           [ivd("corrupt")])
+        if "delay" in inj:
+            return verdict("straggler", _inj_round(inj["delay"], intervals),
+                           "high", [ivd("delay")])
+        if "drop" in inj:
+            slot = inj["drop"]
+            if slot["msg_types"] and slot["msg_types"] <= set(
+                    TELEMETRY_MSG_TYPES):
+                rnd = _inj_round(slot, intervals)
+                if rnd is None and ev["slo_violations"]:
+                    rnd = _first(ev["slo_violations"]).get("round")
+                return verdict("telemetry_loss", rnd, "high", [ivd("drop")])
+            return verdict("message_drop", _inj_round(slot, intervals),
+                           "high", [ivd("drop")])
+        any_a = sorted(inj)[0]
+        return verdict(f"chaos:{any_a}", _inj_round(inj[any_a], intervals),
+                       "medium", [ivd(any_a)])
+
+    # 3. hub restart: dialers saw their hub connection die AND come back
+    if ev["reconnects"] and ev["conn_deaths"]:
+        deaths = [d for d in ev["conn_deaths"] if d["tag"] != "hub"]
+        d = _first(deaths or ev["conn_deaths"])
+        return verdict("hub_restart", locate_round(d.get("t"), intervals),
+                       "medium", [
+            {"source": d["tag"], "kind": "conn_death",
+             "reason": d.get("reason")},
+            {"source": "dialers", "kind": "counter",
+             "name": "comm.reconnects", "count": ev["reconnects"]}])
+
+    # 4. shm ring saturation: every payload took the counted fallback
+    ring_full = ev["shm_fallbacks"].get("ring_full", 0.0) + \
+        ev["shm_fallbacks"].get("desc_full", 0.0)
+    if ring_full:
+        refusals = [r for r in ev["shm_refusals"]
+                    if r.get("reason") in ("ring_full", "desc_full")]
+        rnd = locate_round(_first(refusals)["t"], intervals) \
+            if refusals else (intervals[0]["round"] if intervals else None)
+        return verdict("shm_ring_full", rnd, "medium", [
+            {"source": "senders", "kind": "counter",
+             "name": "comm.shm_fallbacks",
+             "by_reason": dict(ev["shm_fallbacks"])}])
+
+    # 5. repeated deadline overruns with nothing injected: a straggler
+    overruns = [o for o in ev["deadline_overruns"]
+                if o.get("round") is not None]
+    if overruns:
+        rounds = sorted({o["round"] for o in overruns})
+        conf = "medium" if len(rounds) >= 2 else "low"
+        return verdict("straggler", rounds[0], conf, [
+            {"source": sorted({o["tag"] for o in overruns}),
+             "kind": "deadline_overrun", "rounds": rounds}])
+
+    # 6. server-side tolerance observations without injector bundles
+    if ev["rejects"]:
+        whats = {r.get("what") for r in ev["rejects"]} - {None}
+        served = [r for r in ev["rejects"] if r.get("round") is not None]
+        rnd = min(r["round"] for r in served) if served else \
+            locate_round(_first(ev["rejects"]).get("t"), intervals)
+        kind = "malicious_client" if "outlier_upload" in whats \
+            else "corrupt_upload"
+        return verdict(kind, rnd, "low", [
+            {"source": "server", "kind": "rejects",
+             "what": sorted(whats), "count": len(ev["rejects"])}])
+
+    # 7. stats-plane SLO violations with healthy rounds
+    if ev["slo_violations"]:
+        v = _first(ev["slo_violations"])
+        return verdict("telemetry_loss", v.get("round"), "low", [
+            {"source": v["tag"], "kind": "slo_violation",
+             "reason": v.get("reason")}])
+
+    if ev["exceptions"]:
+        e = _first(ev["exceptions"])
+        return verdict("exception", locate_round(e.get("t"), intervals),
+                       "low", [{"source": e["tag"], "kind": "exception",
+                                "reason": e.get("reason")}])
+
+    return verdict("none", None, "high",
+                   [{"kind": "no_anomaly",
+                     "detail": "no trigger, injection, or tolerance "
+                               "observation in any bundle"}])
+
+
+# -- round diff -------------------------------------------------------------
+
+def round_profiles(bundles: Dict[str, dict], clock: Clock,
+                   intervals: List[dict]) -> Dict[int, dict]:
+    """Per-round aggregates over every bundle's rings: span medians
+    (queue waits, fold stalls), hub queue-depth samples, comm volume,
+    fault/fallback activity."""
+    spans: Dict[int, Dict[str, list]] = defaultdict(lambda: defaultdict(list))
+    hubq: Dict[int, Dict[str, list]] = defaultdict(lambda: defaultdict(list))
+    scal: Dict[int, Dict[str, float]] = defaultdict(lambda: defaultdict(float))
+    for tag, b in bundles.items():
+        rings = b.get("rings") or {}
+        for row in rings.get("spans", ()):
+            r = locate_round(clock.t(tag, row.get("t_m")), intervals)
+            if r is not None and isinstance(row.get("v"), (int, float)):
+                spans[r][row["kind"]].append(float(row["v"]))
+        for row in rings.get("comm", ()):
+            r = locate_round(clock.t(tag, row.get("t_m")), intervals)
+            if r is None:
+                continue
+            k = row.get("kind")
+            if k in ("send", "recv"):
+                scal[r]["comm_frames"] += 1
+                scal[r]["comm_bytes"] += float(row.get("nbytes") or 0)
+            elif k == "shm_refusal":
+                scal[r]["shm_refusals"] += 1
+        for row in rings.get("faults", ()):
+            r = row.get("round")
+            if r is None:
+                r = locate_round(clock.t(tag, row.get("t_m")), intervals)
+            if r is None:
+                continue
+            if row.get("kind") == "decision":
+                scal[r]["fault_decisions"] += 1
+            elif row.get("kind") == "observed":
+                scal[r]["tolerance_observations"] += 1
+        for row in rings.get("events", ()):
+            k = row.get("kind")
+            if k == "hub_stats":
+                r = locate_round(clock.t(tag, row.get("t_m")), intervals)
+                if r is None:
+                    continue
+                for fk, fv in row.items():
+                    if fk in ("t_m", "kind", "ts"):
+                        continue
+                    if isinstance(fv, (int, float)):
+                        hubq[r][fk].append(float(fv))
+            elif k == "degraded_round" and row.get("round") is not None:
+                scal[int(row["round"])]["degraded"] = 1
+    out: Dict[int, dict] = {}
+    for iv in intervals:
+        r = iv["round"]
+        out[r] = {
+            "spans_p50": {name: fed_timeline.percentile(vals, 0.5)
+                          for name, vals in sorted(spans[r].items())},
+            "hub_stats_max": {name: max(vals)
+                              for name, vals in sorted(hubq[r].items())},
+            **{k: v for k, v in sorted(scal[r].items())},
+        }
+    return out
+
+
+def diff_rounds(profiles: Dict[int, dict], bad: Optional[int],
+                anomalous: set) -> Optional[dict]:
+    """Anomalous round vs the NEAREST round not itself implicated."""
+    if bad is None or bad not in profiles:
+        return None
+    healthy = [r for r in profiles if r not in anomalous]
+    if not healthy:
+        return None
+    ref = min(healthy, key=lambda r: (abs(r - bad), r))
+    pb, ph = profiles[bad], profiles[ref]
+
+    def flat(p):
+        out = {}
+        for k, v in p.items():
+            if isinstance(v, dict):
+                for k2, v2 in v.items():
+                    out[f"{k}.{k2}"] = v2
+            else:
+                out[k] = v
+        return out
+
+    fb, fh = flat(pb), flat(ph)
+    metrics = {}
+    for k in sorted(set(fb) | set(fh)):
+        a, h = fb.get(k), fh.get(k)
+        row = {"anomalous": a, "healthy": h}
+        if isinstance(a, (int, float)) and isinstance(h, (int, float)) \
+                and h:
+            row["ratio"] = round(a / h, 3)
+        metrics[k] = row
+    return {"round": bad, "vs_round": ref, "metrics": metrics}
+
+
+# -- perfetto ---------------------------------------------------------------
+
+def to_perfetto(bundles: Dict[str, dict], clock: Clock) -> dict:
+    """Chrome trace-event JSON of the final recorded window: one
+    process track per bundle, one thread per ring category, an instant
+    event per ring entry, a marker per trigger."""
+    events: List[dict] = []
+    tags = sorted(bundles)
+    all_t: List[float] = []
+    for tag in tags:
+        b = bundles[tag]
+        for rows in (b.get("rings") or {}).values():
+            for row in rows:
+                t = clock.t(tag, row.get("t_m"))
+                if t is not None:
+                    all_t.append(t)
+    if not all_t:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    base = min(all_t)
+
+    def us(t: Optional[float]) -> Optional[float]:
+        return None if t is None else round((t - base) * 1e6, 1)
+
+    for pid, tag in enumerate(tags, start=1):
+        b = bundles[tag]
+        events.append({"ph": "M", "name": "process_name", "pid": pid,
+                       "tid": 0, "args": {"name": f"flight {tag}"}})
+        cats = sorted((b.get("rings") or {}))
+        for tid, cat in enumerate(cats, start=1):
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": cat}})
+            for row in (b.get("rings") or {})[cat]:
+                t = us(clock.t(tag, row.get("t_m")))
+                if t is None:
+                    continue
+                args = {k: v for k, v in row.items()
+                        if k not in ("t_m",) and isinstance(
+                            v, (str, int, float, bool))}
+                events.append({"ph": "i", "s": "t", "pid": pid, "tid": tid,
+                               "ts": t, "cat": cat,
+                               "name": str(row.get("kind")), "args": args})
+        events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                       "tid": 0, "args": {"name": "triggers"}})
+        for rec in b.get("history") or ():
+            t = us(clock.t(tag, rec.get("t_m")))
+            if t is None:
+                continue
+            events.append({"ph": "i", "s": "p", "pid": pid, "tid": 0,
+                           "ts": t, "cat": "trigger",
+                           "name": f"trigger:{rec.get('kind')}",
+                           "args": {"reason": rec.get("reason"),
+                                    "round": rec.get("round")}})
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+# -- top level --------------------------------------------------------------
+
+def analyze(run_dir: str) -> dict:
+    """run_dir -> verdict document (the chaos_run / CLI entry point)."""
+    bundles, errors = load_bundles(run_dir)
+    doc = {
+        "schema": SCHEMA,
+        "run_dir": run_dir,
+        "bundles": {tag: b["_path"] for tag, b in bundles.items()},
+        "bundle_errors": errors,
+    }
+    if not bundles:
+        doc.update({"fault_kind": "no_bundles", "fault_round": None,
+                    "confidence": "none", "evidence": [], "rounds": [],
+                    "round_diff": None})
+        return doc
+    clock = Clock(bundles)
+    intervals = round_intervals(bundles, clock)
+    ev = collect_evidence(bundles, clock)
+    v = attribute(bundles, clock, intervals, ev)
+    anomalous = {v["fault_round"]} if v["fault_round"] is not None else set()
+    for o in ev["deadline_overruns"]:
+        if o.get("round") is not None:
+            anomalous.add(o["round"])
+    for r in ev["rejects"]:
+        if r.get("round") is not None:
+            anomalous.add(r["round"])
+    profiles = round_profiles(bundles, clock, intervals)
+    doc.update({
+        "clock_mode": clock.mode,
+        "rounds": intervals,
+        **v,
+        "triggers": [
+            {"tag": tag, "kind": rec.get("kind"),
+             "reason": rec.get("reason"), "round": rec.get("round"),
+             "t": clock.t(tag, rec.get("t_m"))}
+            for tag, b in sorted(bundles.items())
+            for rec in (b.get("history") or ())
+            if rec.get("kind") != "manual"
+        ],
+        "round_profiles": {str(r): p for r, p in profiles.items()},
+        "round_diff": diff_rounds(profiles, v["fault_round"], anomalous),
+    })
+    return doc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("run_dir")
+    ap.add_argument("--out", default="",
+                    help="also write the verdict JSON to this path")
+    ap.add_argument("--perfetto", default="",
+                    help="write a Chrome trace-event export of the "
+                         "final recorded window to this path")
+    args = ap.parse_args(argv)
+    doc = analyze(args.run_dir)
+    if args.perfetto:
+        bundles, _ = load_bundles(args.run_dir)
+        trace = to_perfetto(bundles, Clock(bundles))
+        with open(args.perfetto, "w") as fh:
+            json.dump(trace, fh)
+        print(f"perfetto trace: {args.perfetto} "
+              f"({len(trace['traceEvents'])} events)", file=sys.stderr)
+    out = json.dumps(doc, indent=1, default=str)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(out + "\n")
+    print(out)
+    return 0 if doc.get("fault_kind") != "no_bundles" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -30,6 +30,7 @@ from fedml_tpu.analysis.locks import assert_held, make_lock
 from fedml_tpu.comm.backend import CommBackend, NodeManager
 from fedml_tpu.comm.message import (
     MSG_ARG_KEY_CLIENT_INDEX,
+    MSG_ARG_KEY_CONTRIBUTORS,
     MSG_ARG_KEY_DELTA_BASE,
     MSG_ARG_KEY_LOCAL_METRICS,
     MSG_ARG_KEY_MODEL_PARAMS,
@@ -38,6 +39,7 @@ from fedml_tpu.comm.message import (
     MSG_TYPE_C2S_RESYNC,
     MSG_TYPE_C2S_SEND_MODEL,
     MSG_TYPE_C2S_TELEMETRY,
+    MSG_TYPE_E2S_PARTIAL,
     MSG_TYPE_S2C_FINISH,
     MSG_TYPE_S2C_INIT_CONFIG,
     MSG_TYPE_S2C_SYNC_MODEL,
@@ -240,6 +242,52 @@ def request_resync(send, node_id: int, round_idx) -> None:
     resync = Message(MSG_TYPE_C2S_RESYNC, node_id, SERVER)
     resync.add_params(MSG_ARG_KEY_ROUND_INDEX, round_idx)
     send(resync)
+
+
+class UploadRejected(Exception):
+    """A client upload failed the decode or the non-finite firewall.
+    ``kind`` is the canonical ``faults.observed{kind=}`` label."""
+
+    def __init__(self, kind: str):
+        super().__init__(kind)
+        self.kind = kind
+
+
+def decode_validated_upload(msg: Message, base):
+    """THE shared upload intake — the root server's decode path AND the
+    edge hub's (``algorithms/edge_hub``), so the two tiers cannot drift:
+    an upload the root would have rejected must never survive by hiding
+    inside an edge partial, and vice versa.
+
+    Decodes the wire payload against ``base`` (applying delta-upload
+    semantics), then runs the corrupt-payload firewall: one NaN leaf
+    folded into the weighted sum would poison the global model for
+    every round after, at either tier.
+
+    Returns ``(variables, n)``; raises :class:`UploadRejected` with the
+    canonical fault kind on any bad upload."""
+    try:
+        payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
+        variables = tree_from_wire(payload, base)
+        if tree_is_delta(payload):
+            # codec-encoded UPDATE: decoded leaves are fp32 deltas; the
+            # upload's model is base + delta (what the client's
+            # error-feedback recurrence assumes the aggregator sees)
+            variables = jax.tree_util.tree_map(
+                lambda b, d: np.asarray(b, np.float32) + d,
+                base, variables,
+            )
+    except Exception as e:
+        # an undecodable payload (truncated/garbled frame) is a fault
+        # observation, not an aggregator crash
+        raise UploadRejected("undecodable_upload") from e
+    n = msg.get(MSG_ARG_KEY_NUM_SAMPLES)
+    if n is None or not np.isfinite(n) or n <= 0 or not all(
+        np.isfinite(np.asarray(l)).all()
+        for l in jax.tree_util.tree_leaves(variables)
+    ):
+        raise UploadRejected("corrupt_upload")
+    return variables, float(n)
 
 
 def bcast_wire_nbytes(wire: dict) -> int:
@@ -470,8 +518,23 @@ class FedAvgServerManager(NodeManager):
         # broadcast groups by them: leaf lock, ordered round_lock ->
         # _ack_lock at every site that holds both
         self._ack_lock = make_lock("FedAvgServerManager._ack_lock")
+        # deferred chain advance (encode thread): set while no advance
+        # is pending; cleared at a close that hands the advance to
+        # _broadcast_async, re-set there.  Off-thread readers of the
+        # post-advance model (_on_resync's _full_wire) wait on it
+        # OUTSIDE the round lock.
+        self._chain_done = threading.Event()
+        self._chain_done.set()
         self._agg_acc = None
         self._agg_n = 0.0
+        # edge-partial decode template: tree_from_wire casts decoded
+        # leaves to the template's dtype, and a partial's num tree IS
+        # the edge's fp64 streaming accumulator — decoding against the
+        # fp32 model would downcast mid-wire and break the tree-vs-flat
+        # byte-identity pin
+        self._f64_template = jax.tree_util.tree_map(
+            lambda l: np.asarray(l, np.float64), init_variables
+        )
         # per-connection num/den accumulators (conn caps only):
         # O(connections · model) — connections, not clients, is the
         # muxed federation's small axis
@@ -576,6 +639,11 @@ class FedAvgServerManager(NodeManager):
     def register_message_receive_handlers(self):
         self.register_message_receive_handler(
             MSG_TYPE_C2S_SEND_MODEL, self._on_model
+        )
+        # hierarchical aggregation: an edge hub's pre-folded
+        # (sum n·model, sum n) pair for the current round
+        self.register_message_receive_handler(
+            MSG_TYPE_E2S_PARTIAL, self._on_partial
         )
         # registered even with the stats plane OFF: a half-configured
         # federation (clients reporting, server arm disabled) must drop
@@ -885,15 +953,22 @@ class FedAvgServerManager(NodeManager):
             self._full_wire_cache = cached
         return cached[1]
 
-    def _advance_chain(self, prev_model) -> None:  # fedlint: holds=_round_lock
+    def _advance_chain(self, prev_model,
+                       next_round: Optional[int] = None) -> None:  # fedlint: holds=_round_lock
         """Close-time half of the delta broadcast (caller holds the
         round lock): U = aggregate − M_r + residual, encoded on the
         seeded broadcast stream; M_{r+1} := M_r + decode(encode(U));
         residual := U − decode(encode(U)).  The encoded wire lands in
         the bounded delta log under round r+1 (the sync that ships it
-        first)."""
+        first).  ``next_round`` must be passed EXPLICITLY when the call
+        is deferred past the close's ``round_idx`` increment (the
+        encode-thread path): deriving it from ``self.round_idx`` there
+        would land the wire one slot too high AND shift the encode
+        seed — the server's chain state then silently skews from every
+        receiver's."""
         assert_held(self._round_lock, "FedAvgServerManager._advance_chain")
-        next_round = self.round_idx + 1
+        if next_round is None:
+            next_round = self.round_idx + 1
         raw = jax.tree_util.tree_map(
             lambda a, b: np.asarray(a, np.float32) - np.asarray(b, np.float32),
             self.variables, prev_model,
@@ -1022,6 +1097,16 @@ class FedAvgServerManager(NodeManager):
         a rejoined muxer's whole cohort resyncs at once, and per-node
         encodes under the round lock would serialize O(cohort x model)
         work in front of upload folding."""
+        # deferred chain advance: _full_wire below must serve the
+        # ADVANCED model.  Wait OUTSIDE the round lock — the encode
+        # thread takes it for the advance, so waiting inside would
+        # deadlock the very thread we are waiting on.
+        if not self._chain_done.wait(timeout=30.0):
+            logging.warning(
+                "resync from node %d: deferred chain advance still "
+                "pending after 30s — serving the current model",
+                msg.sender,
+            )
         with self._round_lock:
             with self._ack_lock:
                 self._acked.pop(msg.sender, None)
@@ -1112,30 +1197,11 @@ class FedAvgServerManager(NodeManager):
         # simultaneous uploads would otherwise serialize behind one
         # lock with the deadline timer blocked at the back of the queue
         try:
-            payload = msg.get(MSG_ARG_KEY_MODEL_PARAMS)
-            variables = tree_from_wire(payload, base)
-            if tree_is_delta(payload):
-                # codec-encoded UPDATE: decoded leaves are fp32 deltas;
-                # the upload's model is base + delta (what the client's
-                # error-feedback recurrence assumes the server sees)
-                variables = jax.tree_util.tree_map(
-                    lambda b, d: np.asarray(b, np.float32) + d,
-                    base, variables,
-                )
-        except Exception:
-            # an undecodable payload (truncated/garbled frame) is a
-            # fault observation, not a server crash
-            self._reject_upload(msg.sender, "undecodable_upload")
-            return
-        n = msg.get(MSG_ARG_KEY_NUM_SAMPLES)
-        # corrupt-payload firewall: one NaN leaf folded into the
-        # weighted sum would poison the global model for every
-        # round after — reject non-finite models/weights up front
-        if n is None or not np.isfinite(n) or n <= 0 or not all(
-            np.isfinite(np.asarray(l)).all()
-            for l in jax.tree_util.tree_leaves(variables)
-        ):
-            self._reject_upload(msg.sender, "corrupt_upload")
+            # shared decode + corrupt-payload firewall (the edge hub
+            # runs the identical intake on its tier)
+            variables, n = decode_validated_upload(msg, base)
+        except UploadRejected as bad:
+            self._reject_upload(msg.sender, bad.kind)
             return
         conn_key = None
         if self._robust is not None:
@@ -1267,6 +1333,186 @@ class FedAvgServerManager(NodeManager):
             "aggregation)", round_idx, kind, sender,
         )
 
+    def _on_partial(self, msg: Message) -> None:
+        """One edge hub's pre-folded (sum n·model, sum n) pair
+        (``MSG_TYPE_E2S_PARTIAL``, from ``algorithms/edge_hub``).
+
+        The num/den formulation composes exactly with the flat
+        streaming fold: the edge's accumulator is the same fp64
+        ``tree_fold_weighted`` sum the root would have built from the
+        raw uploads, and fp64 addition of those partial sums is exact
+        at training magnitudes — so a tree run's final model is
+        byte-identical to the same-seed flat run's (the tiered twin of
+        PR 10's muxed-vs-per-process pin).
+
+        An edge may flush MORE than one partial per round: ack-group
+        sync frames can extend its expected cohort after a first flush,
+        and late local stragglers flush as singletons after its local
+        deadline.  Contributor sets must be disjoint — an overlap with
+        already-counted reporters drops the whole partial, counted."""
+        reply_round = msg.get(MSG_ARG_KEY_ROUND_INDEX)
+        if not self.streaming_agg or self._defense_buffered:
+            # a buffered estimator (median/trimmed-mean) needs the
+            # per-client trees; a pre-folded pair cannot feed it, and
+            # silently folding would undefend the round.  Refuse loudly.
+            get_telemetry().inc("faults.observed",
+                                kind="partial_unsupported",
+                                msg_type=MSG_TYPE_E2S_PARTIAL)
+            logging.warning(
+                "E2S_PARTIAL from node %d dropped: root is not on the "
+                "streaming fold (legacy hotpath or buffered defense) — "
+                "the tree topology requires --hotpath fast and a "
+                "streaming-composable defense", msg.sender,
+            )
+            return
+        with self._round_lock:
+            if self.bcast == "delta" and reply_round is not None:
+                # implicit acks for every contributor: a partial
+                # echoing round r proves those nodes received round r's
+                # sync (same inference as _on_model's — the edge only
+                # folds uploads that echoed its current round)
+                contrib_keys = msg.get(MSG_ARG_KEY_CONTRIBUTORS) or {}
+                with self._ack_lock:
+                    for node_s in contrib_keys:
+                        try:
+                            node = int(node_s)
+                        except (TypeError, ValueError):
+                            continue
+                        prev = self._acked.get(node)
+                        if prev is None or int(reply_round) > prev:
+                            self._acked[node] = int(reply_round)
+            if self._is_stale(msg, reply_round):
+                return
+        if self._decode_pool is not None:
+            # same pipeline as raw uploads: decode + validation off the
+            # reader thread, slab-backed payloads pinned across the
+            # handoff
+            unpin = msg.pin_payload()
+            self._decode_pool.submit(
+                self._fold_partial_pinned, msg, reply_round,
+                time.perf_counter(), unpin,
+            )
+            return
+        self._fold_partial(msg, reply_round, None)
+
+    def _fold_partial_pinned(self, msg, reply_round, t_submit,
+                             unpin) -> None:
+        try:
+            self._fold_partial(msg, reply_round, t_submit)
+        finally:
+            unpin()
+
+    def _fold_partial(self, msg: Message, reply_round,
+                      t_submit: Optional[float]) -> None:
+        try:
+            self._fold_partial_inner(msg, reply_round, t_submit)
+        except Exception:
+            # same contract as _decode_and_fold: a pool task's
+            # exception dies in its Future — log + count, never hang
+            # the round silently
+            logging.exception("partial decode/fold failed for edge "
+                              "uplink %d", msg.sender)
+            self._reject_upload(msg.sender, "undecodable_partial")
+
+    def _fold_partial_inner(self, msg: Message, reply_round,
+                            t_submit: Optional[float]) -> None:
+        wait_s = 0.0
+        t_start = time.perf_counter()
+        if t_submit is not None:
+            wait_s = t_start - t_submit
+            get_telemetry().observe("span.decode_wait_s", wait_s)
+        try:
+            # decode against the fp64 template (NOT self.variables):
+            # the num tree is the edge's fp64 accumulator and must stay
+            # fp64 through the wire for the exact composition
+            num = tree_from_wire(msg.get(MSG_ARG_KEY_MODEL_PARAMS),
+                                 self._f64_template)
+        except Exception:
+            self._reject_upload(msg.sender, "undecodable_partial")
+            return
+        den = msg.get(MSG_ARG_KEY_NUM_SAMPLES)
+        contrib: Dict[int, float] = {}
+        ok = den is not None and np.isfinite(den) and den > 0
+        try:
+            for node_s, n_i in (msg.get(MSG_ARG_KEY_CONTRIBUTORS)
+                                or {}).items():
+                w = float(n_i)
+                ok = ok and bool(np.isfinite(w)) and w > 0
+                contrib[int(node_s)] = w
+        except (TypeError, ValueError):
+            ok = False
+        # the corrupt-payload firewall, tier-2 edition: the edge
+        # screened each upload, but the partial itself crossed a wire
+        if not ok or not contrib or not all(
+            np.isfinite(np.asarray(l)).all()
+            for l in jax.tree_util.tree_leaves(num)
+        ):
+            self._reject_upload(msg.sender, "corrupt_partial")
+            return
+        if msg._region is not None:
+            # slab-backed frame: decoded fp64 leaves are views into the
+            # shm ring; adopted directly as the accumulator (first
+            # partial of the round) they would outlive the pin — own
+            # the bytes here
+            num = jax.tree_util.tree_map(
+                lambda l: np.array(l, copy=True), num
+            )
+        decode_s = time.perf_counter() - t_start
+        tel = get_telemetry()
+        tel.observe("span.decode_s", decode_s)
+        with self._round_lock:
+            # re-check: the round may have closed while decoding
+            if self._is_stale(msg, reply_round):
+                return
+            self._last_decode_wait_s = wait_s
+            self._last_decode_s = decode_s
+            if any(n in self.pending for n in contrib):
+                # contributor overlap with already-counted reporters
+                # (edge redelivery after a reconnect): the streaming
+                # fold cannot un-fold the first copy — drop the whole
+                # partial, counted
+                tel.inc("faults.observed", kind="duplicate_upload",
+                        msg_type=MSG_TYPE_E2S_PARTIAL)
+                return
+            t0 = time.perf_counter()
+            if self._conn_cap > 0:
+                # contribution caps over the tree: each partial carries
+                # its edge-LOCAL connection group, so the cap keeps the
+                # flat run's granularity (physical client/muxer conns).
+                # An untagged partial degrades to one group per edge —
+                # the whole edge link capped as a unit, never uncapped.
+                key = msg.get("conn_group")
+                key = str(key) if key else f"edge:{msg.sender}"
+                acc = self._conn_acc.get(key)
+                self._conn_acc[key] = (
+                    num if acc is None
+                    else jax.tree_util.tree_map(np.add, acc, num)
+                )
+                self._conn_n[key] = (self._conn_n.get(key, 0.0)
+                                     + float(den))
+            else:
+                # numpy fp64 add — the same arithmetic domain as
+                # tree_fold_weighted's accumulator, NOT jnp (a jit add
+                # would leave the exact-composition contract)
+                self._agg_acc = (
+                    num if self._agg_acc is None
+                    else jax.tree_util.tree_map(np.add, self._agg_acc,
+                                                num)
+                )
+            self._agg_n += float(den)
+            tel.observe("span.agg_fold_s", time.perf_counter() - t0)
+            tel.inc("edge.partials_folded")
+            for node in sorted(contrib):
+                self.pending[node] = {"n": contrib[node], "metrics": {}}
+            if len(self.pending) < self.clients_per_round:
+                return
+            try:
+                self._close_round()
+            except Exception:
+                # same wedge prevention as the upload path
+                logging.exception("round close from partial path failed")
+                self._arm_deadline()
+
     def _close_round(self, dropped_all: bool = False):  # fedlint: holds=_round_lock
         """Aggregate whatever arrived and advance (caller holds the
         round lock).  Weighted average over any non-empty subset ==
@@ -1346,15 +1592,25 @@ class FedAvgServerManager(NodeManager):
             # same span series the simulation drivers feed (obs layer):
             # the reference's FedAVGAggregator.py:59,85-86 aggregate timer
             get_telemetry().observe("span.agg_s", time_agg)
-        if self._chain:
-            # quantized-chain advance (delta mode, and the full-mode
-            # digest-pin arm at an explicit chain codec): encode the
-            # aggregate update (+ the EF residual), decode OUR OWN
-            # encoding, and adopt base + decode as the canonical next
-            # model — every receiver of the delta reconstructs exactly
-            # this, and the quantization error is carried, not lost.
-            # On a dropped_all round the update is just the pending
-            # residual (the chain still advances deterministically).
+        # quantized-chain advance (delta mode, and the full-mode
+        # digest-pin arm at an explicit chain codec): encode the
+        # aggregate update (+ the EF residual), decode OUR OWN
+        # encoding, and adopt base + decode as the canonical next
+        # model — every receiver of the delta reconstructs exactly
+        # this, and the quantization error is carried, not lost.
+        # On a dropped_all round the update is just the pending
+        # residual (the chain still advances deterministically).
+        # With the encode thread available AND a broadcast still to
+        # come, the O(model) encode+decode moves OFF the round lock
+        # onto _broadcast_async (PR 13 leftover) — ordering stays
+        # safe because the next round's uploads reconstruct against
+        # the model that broadcast ships, and the broadcast runs on
+        # the same thread AFTER the advance.  The FINAL close has no
+        # broadcast to ride, so it advances synchronously (the final
+        # model must adopt the last quantization step either way).
+        defer_chain = (self._chain and self._encode_pool is not None
+                       and self.round_idx + 1 < self.comm_rounds)
+        if self._chain and not defer_chain:
             self._advance_chain(prev_model)
         # wall-clock close stamp: deltas between consecutive recs are
         # the per-round wall time a federation artifact reports; the
@@ -1476,16 +1732,40 @@ class FedAvgServerManager(NodeManager):
             # while the next sync serializes.  Safe lock-free reads:
             # self.variables/round_idx only change at the NEXT close,
             # which cannot happen before this broadcast reaches clients.
-            self._encode_pool.submit(self._broadcast_async, self.round_idx)
+            if defer_chain:
+                self._chain_done.clear()
+            self._encode_pool.submit(
+                self._broadcast_async, self.round_idx,
+                prev_model if defer_chain else None,
+            )
         else:
             self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL)
             self._arm_deadline()
 
-    def _broadcast_async(self, round_gen: int) -> None:
-        """Encode-thread body: broadcast the new round's sync, record
-        the overlapped span, then arm the deadline (the deadline must
-        not start ticking before the sync is on the wire — same
-        ordering as the synchronous path)."""
+    def _broadcast_async(self, round_gen: int, chain_prev=None) -> None:
+        """Encode-thread body: (optionally) run the deferred chain
+        advance, broadcast the new round's sync, record the overlapped
+        span, then arm the deadline (the deadline must not start
+        ticking before the sync is on the wire — same ordering as the
+        synchronous path)."""
+        if chain_prev is not None:
+            # deferred _advance_chain (close handed us M_r): takes the
+            # round lock itself — the close-path caller released it
+            # when it submitted this task.  _chain_done gates the rare
+            # off-thread readers of the post-advance model (resync
+            # unicasts) that can run in the close→advance gap.
+            try:
+                # round_gen is the post-increment round index — exactly
+                # the next_round this advance is producing the wire for
+                with self._round_lock:
+                    self._advance_chain(chain_prev, round_gen)
+            except Exception:
+                logging.exception(
+                    "round %d: deferred chain advance failed "
+                    "(broadcasting the unadvanced model)", round_gen,
+                )
+            finally:
+                self._chain_done.set()
         t0 = time.perf_counter()
         try:
             self._broadcast_model(MSG_TYPE_S2C_SYNC_MODEL)

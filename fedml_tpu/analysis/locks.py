@@ -34,6 +34,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Dict, List, Optional, Set, Tuple, Union
 
 ENV_ENABLE = "FEDML_TPU_CHECKED_LOCKS"
@@ -46,10 +47,13 @@ _edges: Set[Tuple[str, str]] = set()  # (held lock name, acquired lock name)
 _held_local = threading.local()
 
 # single acquisition tap (the flight recorder's lock ring): called as
-# ``fn(lock_name, held_depth)`` after every successful CheckedLock
-# acquire.  Atomic ref swap, exceptions swallowed at the call site —
-# same contract as the telemetry taps.  Costs nothing with checking
-# off (plain Locks never reach it).
+# ``fn(lock_name, held_depth, wait_s)`` after every successful
+# CheckedLock acquire, where ``wait_s`` is the measured block time of
+# the underlying acquire — the runtime CONTENTION probe (a hot lock
+# shows up as nonzero waits in the flight ring, not as a hunch).
+# Atomic ref swap, exceptions swallowed at the call site — same
+# contract as the telemetry taps.  Costs nothing with checking off
+# (plain Locks never reach it).
 _acquire_tap = None
 
 
@@ -113,13 +117,15 @@ class CheckedLock:
                 for held in stack:
                     if held.name != self.name:
                         _edges.add((held.name, self.name))
+        t0 = time.perf_counter()
         ok = self._lock.acquire(blocking, timeout)
         if ok:
             stack.append(self)
             tap = _acquire_tap
             if tap is not None:
                 try:
-                    tap(self.name, len(stack))
+                    tap(self.name, len(stack),
+                        time.perf_counter() - t0)
                 except Exception:
                     pass
         return ok

@@ -38,6 +38,11 @@ RESERVED_KEYS: Dict[str, Tuple[str, str]] = {
     "__trace__": ("TRACE_KEY", "fedml_tpu/obs/trace_ctx.py"),
     "__digest__": ("DIGEST_KEY", "fedml_tpu/obs/digest.py"),
     "__shmseq__": ("SHM_SEQ_KEY", "fedml_tpu/comm/message.py"),
+    # not a frame-header key but the same drift class: the edge-hub
+    # uplink's partial-aggregate message tag is protocol between two
+    # tiers of aggregator — a literal copy in a second module would
+    # let the tiers skew silently
+    "E2S_PARTIAL": ("MSG_TYPE_E2S_PARTIAL", "fedml_tpu/comm/message.py"),
 }
 
 

@@ -93,6 +93,20 @@ MSG_TYPE_C2S_RESYNC = "C2S_RESYNC"
 # sync-envelope param naming the base round a delta broadcast applies
 # to (the receiver reconstructs base + the shipped per-round deltas)
 MSG_ARG_KEY_DELTA_BASE = "delta_base"
+# hierarchical aggregation (fedml_tpu/algorithms/edge_hub.py): an edge
+# hub terminates its cohort's connections, folds their uploads with the
+# same O(1) streaming aggregation the root runs, and uplinks ONE
+# pre-folded (sum n·model, sum n) pair per round.  The num/den
+# formulation composes exactly (fp64 sums are order-independent at
+# training magnitudes), so a tree run's final model is byte-identical
+# to the flat run's.  Registered in analysis/wire_schema.py: a literal
+# copy of this tag in a second module is wire-format drift.
+MSG_TYPE_E2S_PARTIAL = "E2S_PARTIAL"
+# E2S_PARTIAL param: {node_id (str): num_samples} for every upload the
+# edge folded into this frame — the root materializes them as this
+# round's reporters (participation accounting, delta-broadcast acks,
+# duplicate screening) without ever seeing the per-client models
+MSG_ARG_KEY_CONTRIBUTORS = "contributors"
 # split-learning extras (reference split_nn/message_define.py:6-16)
 MSG_TYPE_C2S_SEND_ACTS = "C2S_SEND_ACTS"
 MSG_TYPE_S2C_SEND_GRADS = "S2C_SEND_GRADS"

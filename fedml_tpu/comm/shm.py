@@ -404,13 +404,21 @@ class ShmLane:
 
     def read_copy(self, seq: int, nbytes: int) -> bytes:
         """Materialized read for consumers with unbounded retention
-        (the hub's routing queues, stripe reassembly buffers): one copy
-        out of the slab, region released immediately."""
+        (stripe reassembly buffers, the hub's pin-pressure valve): one
+        copy out of the slab, region released immediately."""
         region = self.read(seq, nbytes)
         try:
             return bytes(region.view)
         finally:
             region.release()
+
+    def inbound_backlog(self) -> int:
+        """Inbound frames read but not yet fully released (live pins).
+        Ring reclamation is in-order, so ONE long-lived pin holds every
+        later frame's bytes too — consumers with queue-length retention
+        (the hub router) use this to decide pin vs materialize."""
+        with self._rlock:
+            return len(self._outstanding)
 
     def _release_seq(self, seq: int) -> None:
         with self._rlock:

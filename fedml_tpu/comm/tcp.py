@@ -97,16 +97,19 @@ def _split_traced_mcast(frame: dict, payload: bytes):
     if not frame.get(trace_ctx.TRACE_KEY):
         return None, None
     hdr = None
-    nl = payload.find(b"\n")
-    if nl >= 0:
+    # split_frame_line, not .find: ``payload`` may be a pinned slab
+    # memoryview (zero-copy hub inbound) — only the header LINE is
+    # ever materialized, the multi-MB tail stays a view
+    end = split_frame_line(payload)
+    if end > 0:
         try:
-            hdr = json.loads(payload[:nl + 1])
+            hdr = json.loads(bytes(payload[:end]))
         except json.JSONDecodeError:
             hdr = None
     if hdr is None or trace_ctx.TRACE_KEY not in hdr:
         return None, None
     trace_ctx.hub_stamp(hdr, "hub_in")
-    return hdr, memoryview(payload)[nl + 1:]
+    return hdr, memoryview(payload)[end:]
 
 
 def _tune_socket(sock: socket.socket) -> None:
@@ -179,9 +182,9 @@ class _Conn:
     mid-payload — the invariant the old per-conn send locks provided,
     now without serializing the fan-out behind the router thread.
 
-    Queue entries are ``(msg_type, parts, hdr, nbytes, rids)``: for an
-    untraced frame ``hdr`` is None and ``parts`` is the complete wire
-    frame; for a TRACED frame ``hdr`` is the parsed header dict (shared
+    Queue entries are ``(msg_type, parts, hdr, nbytes, rids, region)``:
+    for an untraced frame ``hdr`` is None and ``parts`` is the complete
+    wire frame; for a TRACED frame ``hdr`` is the parsed header dict (shared
     across an mcast's receiver queues) — or a deferred ``(kind, meta,
     inner header)`` tuple — and ``parts`` holds only the payload tail:
     the sender worker re-encodes the header line with a fresh
@@ -192,7 +195,12 @@ class _Conn:
     frame queued for an id that was REBOUND to a newer connection
     while waiting dies with straggler semantics instead of being
     delivered to the displaced owner (the rebind policy's "old conn
-    loses it" must hold for in-flight frames too).
+    loses it" must hold for in-flight frames too).  ``region`` is the
+    refcounted slab pin (``ShmRegion``) backing a zero-copy laned
+    payload, or None: every enqueue occurrence holds one retain() and
+    every drain — sent, dropped, dead-conn leftover — releases exactly
+    one, so the ring reclaims the bytes when the LAST queue drains them
+    and never before.
 
     ``heads`` is a strict-priority queue in front of ``frames``: a
     striped mcast enqueues every receiver's stripe 0 there, and a
@@ -206,16 +214,25 @@ class _Conn:
     would drain head+tail together)."""
 
     __slots__ = ("sock", "frames", "heads", "nbytes", "scheduled",
-                 "ids", "mux", "cid", "dead", "lane")
+                 "ids", "ranges", "mux", "cid", "dead", "lane")
 
     def __init__(self, sock: socket.socket, ids=(), mux: bool = False,
-                 lane=None):
+                 lane=None, ranges=()):
         self.sock = sock
-        self.frames: deque = deque()  # (msg_type, parts, hdr, nbytes, rids)
+        self.frames: deque = deque()  # (msg_type, parts, hdr, nbytes, rids, region)
         self.heads: deque = deque()  # same entries, strict priority
         self.nbytes = 0
         self.scheduled = False
         self.ids = set(ids)
+        # hello v2 RANGE claim (an edge-hub uplink owning a contiguous
+        # cohort): inclusive (lo, hi) intervals routed here WITHOUT a
+        # per-id entry in the hub's node map — the whole point of the
+        # claim is that the root tier's state stays O(connections), not
+        # O(virtual clients).  A range is an atom: it can only be lost
+        # by the connection dying or a later claim displacing the WHOLE
+        # conn (never id-by-id), so drain-time rebind filtering skips
+        # per-id checks for pure-range conns.
+        self.ranges = tuple((int(lo), int(hi)) for lo, hi in ranges)
         self.mux = mux
         self.cid = 0
         self.dead = False
@@ -224,6 +241,19 @@ class _Conn:
         # directions ride its rings while every header stays on this
         # socket (order, control frames, and fallback are the stream's)
         self.lane = lane
+
+    def covers(self, nid: int) -> bool:
+        """True when this conn routes ``nid`` (per-id claim or range)."""
+        if nid in self.ids:
+            return True
+        for lo, hi in self.ranges:
+            if lo <= nid <= hi:
+                return True
+        return False
+
+    def claimed(self) -> int:
+        """Total node ids this conn claims (ids + range sizes)."""
+        return len(self.ids) + sum(hi - lo + 1 for lo, hi in self.ranges)
 
 
 class TcpHub:
@@ -243,6 +273,7 @@ class TcpHub:
     # the socket itself)
     _GUARDED_BY = {
         "_conns": "_lock",
+        "_range_conns": "_lock",
         "dropped_frames": "_lock",
         "backpressure_drops": "_lock",
         "mcast_frames": "_lock",
@@ -253,6 +284,7 @@ class TcpHub:
         "shm_frames": "_lock",
         "shm_bytes": "_lock",
         "shm_fallbacks": "_lock",
+        "shm_hub_copies": "_lock",
     }
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
@@ -303,6 +335,12 @@ class TcpHub:
         self.shm_frames = 0
         self.shm_bytes = 0
         self.shm_fallbacks = 0
+        # zero-copy inbound: laned payloads normally route as refcounted
+        # slab PINS (ShmRegion) straight into the send queues — this
+        # counts the defensive materializations (pin-pressure valve)
+        # that copied instead, so the fast path's "no copies" claim is
+        # testable rather than assumed
+        self.shm_hub_copies = 0
         # payloads below this ride inline TCP (policy, not fallback)
         self._shm_min = max(0, int(shm_min_bytes))
         self._max_queue_bytes = max_queue_bytes
@@ -310,6 +348,12 @@ class TcpHub:
         # node id -> connection; MANY-TO-ONE since hello v2 (a muxer
         # registers all its virtual node ids on one socket)
         self._conns: Dict[int, _Conn] = {}
+        # connections that claimed contiguous id RANGES (edge-hub
+        # uplinks): kept OUT of the per-id map so the root hub's memory
+        # stays O(connections) no matter how many virtual clients live
+        # behind each edge — routing falls back to a scan of this short
+        # list on a per-id miss
+        self._range_conns: List[_Conn] = []
         self._cids = itertools.count(1)  # per-connection telemetry ids
         self._lock = make_lock("TcpHub._lock")
         self._ready: "queue.SimpleQueue" = queue.SimpleQueue()
@@ -345,7 +389,22 @@ class TcpHub:
             if not hello:
                 return
             hello_obj = json.loads(hello)
-            if "node_ids" in hello_obj:
+            ranges: List[tuple] = []
+            if "node_ranges" in hello_obj:
+                # hello v2 RANGE claim (edge-hub uplink): the conn owns
+                # whole contiguous id intervals.  No per-id entries are
+                # materialized — at 100k virtual clients behind 4 edges
+                # the per-id form costs the ROOT hub ~33 MB of node-map
+                # + hello-parse state that exists only to say "these
+                # 25000 consecutive ids live here"
+                ranges = [(int(lo), int(hi))
+                          for lo, hi in hello_obj["node_ranges"]]
+                if not ranges or any(lo > hi for lo, hi in ranges):
+                    return  # empty/inverted claim: nothing to route
+                ids = []
+                mux = True
+                node_id = ranges[0][0]
+            elif "node_ids" in hello_obj:
                 # hello v2: one connection registers MANY node ids (a
                 # muxer's virtual clients); v1 dialers keep sending the
                 # single node_id form and both interop on one hub
@@ -353,10 +412,11 @@ class TcpHub:
                 mux = True
                 if not ids:
                     return  # empty registration: nothing to route
+                node_id = ids[0]  # primary id: peers replies, logging
             else:
                 ids = [int(hello_obj["node_id"])]
                 mux = False
-            node_id = ids[0]  # primary id: peers replies, logging
+                node_id = ids[0]
             # shm-lane capability (hello key "shm"): the dialer created
             # a slab and advertises it; attach if we can reach it (the
             # same-box test IS the attach — a cross-host name simply
@@ -415,11 +475,46 @@ class TcpHub:
                 # pre-handshake peers (an old dialer): fall through to
                 # registration and let the main loop service this line
                 break
-            st = _Conn(conn, ids=ids, mux=mux, lane=lane)
+            st = _Conn(conn, ids=ids, mux=mux, lane=lane, ranges=ranges)
             rebound: List[int] = []
             stale_conns: List[_Conn] = []
             with self._lock:
                 st.cid = next(self._cids)
+                # range conns are DISPLACED AS ATOMS: any overlap with a
+                # new claim (id or range) kills the old conn's whole
+                # claim — an edge IS its cohort; there is no id-by-id
+                # partial rebind of a range.  Counted per covered id so
+                # the rebind series stays comparable across claim forms.
+                claimed_ranges = st.ranges
+                for rc in [c for c in self._range_conns if c is not st]:
+                    hit = any(rc.covers(nid) for nid in ids) or any(
+                        lo <= rhi and rlo <= hi
+                        for lo, hi in claimed_ranges
+                        for rlo, rhi in rc.ranges)
+                    if hit:
+                        self.node_rebinds += rc.claimed()
+                        rc.dead = True
+                        self._range_conns.remove(rc)
+                        stale_conns.append(rc)
+                        logging.warning(
+                            "hub: range claim %s displaces conn cid=%s "
+                            "(ranges %s) entirely — rebind",
+                            claimed_ranges or ids[:8], rc.cid, rc.ranges,
+                        )
+                if claimed_ranges:
+                    # a new RANGE claim also steals any per-id claims it
+                    # covers (same new-conn-wins policy; the node map is
+                    # small wherever range claims happen — the root tier)
+                    for nid, old in list(self._conns.items()):
+                        if old is not st and st.covers(nid):
+                            self.node_rebinds += 1
+                            rebound.append(nid)
+                            old.ids.discard(nid)
+                            del self._conns[nid]
+                            if not old.ids and not old.ranges:
+                                old.dead = True
+                                stale_conns.append(old)
+                    self._range_conns.append(st)
                 for nid in ids:
                     old = self._conns.get(nid)
                     if old is not None and old is not st:
@@ -486,6 +581,7 @@ class TcpHub:
                 # torn descriptor is connection-fatal, exactly like a
                 # garbled header.
                 payload = b""
+                region = None
                 binlen = frame.get(FRAME_BINLEN_KEY)
                 sseq = frame.pop(SHM_SEQ_KEY, None)
                 if binlen and sseq is not None:
@@ -496,7 +592,29 @@ class TcpHub:
                         )
                         break
                     try:
-                        payload = st.lane.read_copy(sseq, binlen)
+                        if (st.lane.inbound_backlog() * 2
+                                >= st.lane.nslots):
+                            # pin-pressure valve: ring reclamation is
+                            # in-order, so pins parked in one slow
+                            # conn's send queue hold every LATER
+                            # frame's bytes too.  With half the
+                            # descriptor slots still pinned,
+                            # materialize this frame (one copy,
+                            # counted) instead of letting the writer's
+                            # ring stall into inline-TCP fallbacks.
+                            payload = st.lane.read_copy(sseq, binlen)
+                            with self._lock:
+                                self.shm_hub_copies += 1
+                            get_telemetry().inc("comm.shm_hub_copies",
+                                                reason="pin_pressure")
+                        else:
+                            # zero-copy: the routing queues hold
+                            # refcounted PINS into the slab — the
+                            # sender pool releases each entry's
+                            # reference on drain, and the reader's own
+                            # reference dies with this iteration
+                            region = st.lane.read(sseq, binlen)
+                            payload = region.view
                     except ShmLaneError as e:
                         logging.warning(
                             "hub: shm lane error from node %s (%s) — "
@@ -505,145 +623,25 @@ class TcpHub:
                         break
                     with self._lock:
                         self.shm_frames += 1
-                        self.shm_bytes += len(payload)
+                        self.shm_bytes += binlen
                 elif binlen:
                     payload = f.read(binlen)
                     if len(payload) < binlen:
                         break  # peer died mid-payload: torn frame == EOF
-                if frame.get(HUB_KEY) == "mcast":
-                    # hub multicast: ``payload`` is ONE complete inner
-                    # frame (header line + buffers) shipped once over
-                    # the server→hub leg; fan it out by enqueueing the
-                    # SAME immutable bytes per receiver — receivers see
-                    # an ordinary frame, no client-side support needed
-                    receivers = frame.get("receivers") or []
-                    mt = frame.get("msg_type")
-                    if not payload:
-                        logging.warning("hub: mcast frame without payload")
-                        continue
-                    # per-conn dedup FIRST: receivers sharing a muxed
-                    # connection collapse to ONE wrapped copy per
-                    # connection; mcast_copies counts the physical
-                    # copies actually enqueued (== receivers for v1
-                    # dialers, == connections under muxing)
-                    groups, unknown = self._conn_groups(receivers)
-                    for r in unknown:
-                        self._count_drop(r, mt)
-                    with self._lock:
-                        self.mcast_frames += 1
-                        self.mcast_copies += len(groups)
-                    get_telemetry().inc("hub.mcast_frames",
-                                        msg_type=mt or "?")
-                    if (self._stripe_bytes
-                            and len(payload) > self._stripe_bytes
-                            and len(payload) <= _MAX_REASM_BYTES // 2):
-                        self._fan_out_striped(frame, groups, mt, payload)
-                        continue
-                    # traced mcast (outer header flags it): split the
-                    # inner frame at its header line ONCE, stamp hub_in,
-                    # and queue (parsed header, shared payload-tail
-                    # view) per receiver — the sender worker re-encodes
-                    # the small header per copy with its own hub_out
-                    # stamp while the multi-MB tail stays one object.
-                    # Mux wraps (traced AND untraced) are DEFERRED
-                    # (kind, meta, hdr) entries: the worker builds the
-                    # outer line at drain, filtering the target nodes
-                    # against the conn's live id set — a rebind while
-                    # the copy waits must not be fanned out to the
-                    # stolen id by the displaced owner.
-                    hdr, tail = _split_traced_mcast(frame, payload)
-                    for cst, rids in groups:
-                        if not cst.mux:
-                            # plain single-id conn: the pre-mux path
-                            if hdr is not None:
-                                self._forward(rids[0], (tail,),
-                                              msg_type=mt, hdr=hdr,
-                                              nbytes=len(payload),
-                                              conn=cst)
-                            else:
-                                self._forward(rids[0], (payload,),
-                                              msg_type=mt, conn=cst)
-                            continue
-                        body = (tail,) if hdr is not None else (payload,)
-                        ok = self._forward(
-                            rids[0], body, msg_type=mt,
-                            hdr=(MUX_KIND,
-                                 {"nodes": rids, "msg_type": mt}, hdr),
-                            nbytes=len(payload), rids=rids, conn=cst)
-                        if not ok:
-                            # _forward counted the representative id;
-                            # the co-located rest lost the same copy
-                            for r in rids[1:]:
-                                self._count_drop(r, mt)
-                    continue
-                if frame.get(HUB_KEY) == "peers":
-                    # membership introspection: reply to THIS node with
-                    # the currently registered ids (startup barrier —
-                    # frames to unregistered receivers are dropped, so
-                    # coordinators must await their cohort first).
-                    # NOT named ``ids``: that local is THIS conn's
-                    # hello id list, which the cleanup block iterates
-                    with self._lock:
-                        peer_ids = sorted(self._conns)
-                    self._forward(
-                        node_id,
-                        ((json.dumps({HUB_KEY: "peers", "ids": peer_ids})
-                          + "\n").encode(),),
-                    )
-                    continue
-                if frame.get(HUB_KEY) == "conn_map":
-                    # connection-attribution introspection (the robust
-                    # aggregator's anti-Sybil lever): the HUB is the
-                    # authority on which node ids share a physical
-                    # connection — a malicious muxer cannot lie its
-                    # virtual cohort into looking like independent
-                    # connections.  Reply {cid: [node ids]} to the
-                    # requester; one frame per request, no hot-path
-                    # cost for anyone who never asks.
-                    with self._lock:
-                        by_cid: Dict[int, list] = {}
-                        for nid, cst in self._conns.items():
-                            by_cid.setdefault(cst.cid, []).append(nid)
-                    reply = {HUB_KEY: "conn_map",
-                             "conns": {str(c): sorted(v)
-                                       for c, v in by_cid.items()}}
-                    self._forward(
-                        node_id,
-                        ((json.dumps(reply) + "\n").encode(),),
-                    )
-                    continue
-                if frame.get(HUB_KEY) == "stop":
+                try:
+                    keep = self._route_frame(st, node_id, frame, line,
+                                             payload, sseq, region)
+                finally:
+                    if region is not None:
+                        region.release()
+                if not keep:
                     break
-                receiver = frame.get("receiver")
-                if receiver is not None:
-                    if trace_ctx.TRACE_KEY in frame:
-                        # traced unicast: the line IS the header — stamp
-                        # hub_in on the parsed dict and let the sender
-                        # worker re-encode it with hub_out at drain
-                        trace_ctx.hub_stamp(frame, "hub_in")
-                        self._forward(receiver,
-                                      (payload,) if payload else (),
-                                      msg_type=frame.get("msg_type"),
-                                      hdr=frame,
-                                      nbytes=len(line) + len(payload))
-                    else:
-                        if sseq is not None:
-                            # the raw forward ships this header line:
-                            # re-encode it WITHOUT the doorbell key
-                            # (popped above) — the receiver must never
-                            # be told to read someone else's lane.
-                            # Lazy on purpose: the dominant laned
-                            # shapes (mcast/control) re-encode at drain
-                            # from the parsed dict and never pay this.
-                            line = (json.dumps(frame) + "\n").encode()
-                        self._forward(receiver,
-                                      (line, payload) if payload else (line,),
-                                      msg_type=frame.get("msg_type"))
         except OSError:
             pass  # peer vanished: fall through to cleanup
         finally:
             if st is not None:
                 lost: List[int] = []
+                lost_ranges = 0
                 with self._lock:
                     st.dead = True
                     # identity guard: a re-registered node may have
@@ -653,6 +651,23 @@ class TcpHub:
                         if self._conns.get(nid) is st:
                             self._conns.pop(nid, None)
                             lost.append(nid)
+                    if st in self._range_conns:
+                        # a dying range conn takes its whole cohort
+                        # claim with it (displaced conns were already
+                        # removed at rebind time and don't reach here)
+                        self._range_conns.remove(st)
+                        lost_ranges = sum(
+                            hi - lo + 1 for lo, hi in st.ranges)
+                if lost_ranges and self._running:
+                    flight.note("events", "conn_death", cid=st.cid,
+                                mux=st.mux, node_ranges=list(st.ranges),
+                                n_nodes=lost_ranges)
+                    flight.trigger(
+                        "conn_death",
+                        reason=f"hub conn cid={st.cid} died; lost "
+                               f"range claim {list(st.ranges)} "
+                               f"({lost_ranges} node id(s))",
+                    )
                 if lost and self._running:
                     # a live connection died while the hub is serving —
                     # the black box dumps with the per-conn queue
@@ -685,6 +700,181 @@ class TcpHub:
             except OSError:
                 pass
 
+    def _route_frame(self, st: _Conn, node_id: int, frame: dict,
+                     line: bytes, payload, sseq, region) -> bool:
+        """Route ONE inbound frame (header parsed, payload in hand) —
+        the body of the reader loop's dispatch.  Returns False when the
+        connection must close (a ``stop`` frame), True otherwise.
+
+        ``payload`` is bytes on the inline-TCP path and a slab
+        MEMORYVIEW on the zero-copy lane path; ``region`` is the
+        backing pin (or None).  The caller releases the reader's own
+        reference right after this returns, so every enqueue below
+        retains per entry (``_forward`` / ``_forward_stripes``) — the
+        routing layer itself never copies a laned payload."""
+        if frame.get(HUB_KEY) == "mcast":
+            # hub multicast: ``payload`` is ONE complete inner
+            # frame (header line + buffers) shipped once over
+            # the server→hub leg; fan it out by enqueueing the
+            # SAME immutable bytes per receiver — receivers see
+            # an ordinary frame, no client-side support needed
+            receivers = frame.get("receivers") or []
+            mt = frame.get("msg_type")
+            if not payload:
+                logging.warning("hub: mcast frame without payload")
+                return True
+            # per-conn dedup FIRST: receivers sharing a muxed
+            # connection collapse to ONE wrapped copy per
+            # connection; mcast_copies counts the physical
+            # copies actually enqueued (== receivers for v1
+            # dialers, == connections under muxing)
+            groups, unknown = self._conn_groups(receivers)
+            for r in unknown:
+                self._count_drop(r, mt)
+            with self._lock:
+                self.mcast_frames += 1
+                self.mcast_copies += len(groups)
+            get_telemetry().inc("hub.mcast_frames",
+                                msg_type=mt or "?")
+            if (self._stripe_bytes
+                    and len(payload) > self._stripe_bytes
+                    and len(payload) <= _MAX_REASM_BYTES // 2):
+                self._fan_out_striped(frame, groups, mt, payload,
+                                      region=region)
+                return True
+            # traced mcast (outer header flags it): split the
+            # inner frame at its header line ONCE, stamp hub_in,
+            # and queue (parsed header, shared payload-tail
+            # view) per receiver — the sender worker re-encodes
+            # the small header per copy with its own hub_out
+            # stamp while the multi-MB tail stays one object.
+            # Mux wraps (traced AND untraced) are DEFERRED
+            # (kind, meta, hdr) entries: the worker builds the
+            # outer line at drain, filtering the target nodes
+            # against the conn's live id set — a rebind while
+            # the copy waits must not be fanned out to the
+            # stolen id by the displaced owner.
+            hdr, tail = _split_traced_mcast(frame, payload)
+            for cst, rids in groups:
+                if not cst.mux:
+                    # plain single-id conn: the pre-mux path
+                    if hdr is not None:
+                        self._forward(rids[0], (tail,),
+                                      msg_type=mt, hdr=hdr,
+                                      nbytes=len(payload),
+                                      conn=cst, region=region)
+                    else:
+                        self._forward(rids[0], (payload,),
+                                      msg_type=mt, conn=cst,
+                                      region=region)
+                    continue
+                body = (tail,) if hdr is not None else (payload,)
+                meta = self._range_meta(cst, rids) \
+                    or {"nodes": rids}
+                meta["msg_type"] = mt
+                ok = self._forward(
+                    rids[0], body, msg_type=mt,
+                    hdr=(MUX_KIND, meta, hdr),
+                    nbytes=len(payload), rids=rids, conn=cst,
+                    region=region)
+                if not ok:
+                    # _forward counted the representative id;
+                    # the co-located rest lost the same copy
+                    for r in rids[1:]:
+                        self._count_drop(r, mt)
+            return True
+        if frame.get(HUB_KEY) == "peers":
+            # membership introspection: reply to THIS node with
+            # the currently registered ids (startup barrier —
+            # frames to unregistered receivers are dropped, so
+            # coordinators must await their cohort first)
+            with self._lock:
+                peer_ids = sorted(self._conns)
+                peer_ranges = sorted(
+                    [list(r) for rc in self._range_conns
+                     for r in rc.ranges])
+            reply_obj = {HUB_KEY: "peers", "ids": peer_ids}
+            if peer_ranges:
+                # range-claim cohorts are present by [lo, hi], never
+                # enumerated — the whole point is O(edges) state
+                reply_obj["ranges"] = peer_ranges
+            self._forward(
+                node_id,
+                ((json.dumps(reply_obj) + "\n").encode(),),
+            )
+            return True
+        if frame.get(HUB_KEY) == "conn_map":
+            # connection-attribution introspection (the robust
+            # aggregator's anti-Sybil lever): the HUB is the
+            # authority on which node ids share a physical
+            # connection — a malicious muxer cannot lie its
+            # virtual cohort into looking like independent
+            # connections.  Reply {cid: [node ids]} to the
+            # requester; one frame per request, no hot-path
+            # cost for anyone who never asks.
+            with self._lock:
+                by_cid: Dict[int, list] = {}
+                for nid, cst in self._conns.items():
+                    by_cid.setdefault(cst.cid, []).append(nid)
+                range_cids = {str(rc.cid): [list(r) for r in rc.ranges]
+                              for rc in self._range_conns}
+            reply = {HUB_KEY: "conn_map",
+                     "conns": {str(c): sorted(v)
+                               for c, v in by_cid.items()}}
+            if range_cids:
+                # range conns report [lo, hi] spans, not id lists —
+                # the anti-Sybil contract still holds (the hub is
+                # the authority on the claim either way)
+                reply["conn_ranges"] = range_cids
+            self._forward(
+                node_id,
+                ((json.dumps(reply) + "\n").encode(),),
+            )
+            return True
+        if frame.get(HUB_KEY) == "stop":
+            return False
+        receiver = frame.get("receiver")
+        if receiver is not None:
+            if trace_ctx.TRACE_KEY in frame:
+                # traced unicast: the line IS the header — stamp
+                # hub_in on the parsed dict and let the sender
+                # worker re-encode it with hub_out at drain
+                trace_ctx.hub_stamp(frame, "hub_in")
+                self._forward(receiver,
+                              (payload,) if payload else (),
+                              msg_type=frame.get("msg_type"),
+                              hdr=frame,
+                              nbytes=len(line) + len(payload),
+                              region=region)
+            else:
+                if sseq is not None:
+                    # the raw forward ships this header line:
+                    # re-encode it WITHOUT the doorbell key
+                    # (popped above) — the receiver must never
+                    # be told to read someone else's lane.
+                    # Lazy on purpose: the dominant laned
+                    # shapes (mcast/control) re-encode at drain
+                    # from the parsed dict and never pay this.
+                    line = (json.dumps(frame) + "\n").encode()
+                self._forward(receiver,
+                              (line, payload) if payload else (line,),
+                              msg_type=frame.get("msg_type"),
+                              region=region)
+        return True
+
+    def _lookup_locked(self, receiver: int):  # fedlint: holds=_lock
+        """Resolve ``receiver`` to its connection: per-id map first,
+        then the (short) range-claim list."""
+        assert_held(self._lock, "TcpHub._lookup_locked")
+        st = self._conns.get(receiver)
+        if st is not None:
+            return st
+        for rc in self._range_conns:
+            for lo, hi in rc.ranges:
+                if lo <= receiver <= hi:
+                    return rc
+        return None
+
     def _conn_groups(self, receivers):
         """Group a receiver-id list by physical connection (the mcast
         per-conn dedup): ``([(conn, [ids...]), ...], [unknown ids])`` in
@@ -696,7 +886,7 @@ class TcpHub:
         unknown: List[int] = []
         with self._lock:
             for r in receivers:
-                st = self._conns.get(r)
+                st = self._lookup_locked(r)
                 if st is None:
                     unknown.append(r)
                     continue
@@ -708,8 +898,23 @@ class TcpHub:
                 ent[1].append(r)
         return groups, unknown
 
+    @staticmethod
+    def _range_meta(cst, rids):
+        """The compact mux-wrap target for a fully-covered single-range
+        conn, or None.  A sync addressed to an edge's ENTIRE cohort is
+        the common case, and naming 25k consecutive ids costs ~175 KB
+        of outer-header JSON per copy — ``{"range": [lo, hi]}`` says
+        the same thing in constant space.  Partial coverage (sampled
+        participation) falls back to the explicit id list."""
+        if (len(cst.ranges) == 1 and not cst.ids):
+            lo, hi = cst.ranges[0]
+            if len(rids) == hi - lo + 1:
+                return {"range": [lo, hi]}
+        return None
+
     def _forward(self, receiver: int, parts: Tuple, msg_type=None,
-                 hdr=None, nbytes=None, rids=None, conn=None) -> bool:
+                 hdr=None, nbytes=None, rids=None, conn=None,
+                 region=None) -> bool:
         """Enqueue one frame for ``receiver``; the sender pool writes
         it.  Untraced (``hdr=None``): ``parts`` is the COMPLETE frame
         (header line [+ payload]).  Traced: ``hdr`` is the parsed
@@ -725,13 +930,22 @@ class TcpHub:
         ``conn`` pins the target connection (mcast group paths resolve
         it ONCE in ``_conn_groups``): re-resolving by id here could
         land a mux-wrapped copy on a connection that REBOUND the
-        representative id in between — the wrong peer entirely."""
+        representative id in between — the wrong peer entirely.
+
+        ``region`` is the slab pin backing a zero-copy laned payload:
+        retained BEFORE the entry becomes drainable (a sender worker
+        may pop and release it the instant the lock drops) and released
+        back on the drop path — the entry's reference must exist
+        exactly when the entry does."""
         if nbytes is None:
             nbytes = sum(len(p) for p in parts)
         wake = False
         dropped = False
+        if region is not None:
+            region.retain()
         with self._lock:
-            st = conn if conn is not None else self._conns.get(receiver)
+            st = (conn if conn is not None
+                  else self._lookup_locked(receiver))
             if st is None or st.dead:
                 dropped = True
             elif (len(st.frames) + len(st.heads) >= self._max_queue_frames
@@ -740,12 +954,15 @@ class TcpHub:
                 dropped = True
             else:
                 st.frames.append((msg_type, parts, hdr, nbytes,
-                                  tuple(rids) if rids else (receiver,)))
+                                  tuple(rids) if rids else (receiver,),
+                                  region))
                 st.nbytes += nbytes
                 if not st.scheduled:
                     st.scheduled = True
                     wake = True
         if dropped:
+            if region is not None:
+                region.release()
             self._count_drop(receiver, msg_type)
             return False
         if wake:
@@ -753,7 +970,7 @@ class TcpHub:
         return True
 
     def _fan_out_striped(self, frame: dict, groups, mt,
-                         payload: bytes) -> None:
+                         payload, region=None) -> None:
         """Split one mcast payload into ``mcast_stripe`` frames and
         enqueue the stripe sequence to every receiver.
 
@@ -815,7 +1032,8 @@ class TcpHub:
                 # by one hop).
                 meta0 = {"sid": sid, "i": 0, "n": total, "msg_type": mt}
                 if cst.mux:
-                    meta0["nodes"] = rids
+                    meta0.update(self._range_meta(cst, rids)
+                                 or {"nodes": rids})
                 head_entry = (mt, (), (MCAST_STRIPE_KIND, meta0, hdr),
                               len(payload) - len(body) + 64)
             elif cst.mux:
@@ -826,18 +1044,21 @@ class TcpHub:
                 # the local fan-out); the chunk rides as parts
                 ch0 = chunks[0]
                 meta0 = {"sid": sid, "i": 0, "n": total, "msg_type": mt,
-                         "crc": zlib.crc32(ch0), "nodes": rids}
+                         "crc": zlib.crc32(ch0)}
+                meta0.update(self._range_meta(cst, rids)
+                             or {"nodes": rids})
                 head_entry = (mt, (ch0,), (MCAST_STRIPE_KIND, meta0,
                                            None), len(ch0) + 96)
             else:
                 head_entry = chunk_entry(0)
-            self._forward_stripes(cst, rids, [head_entry], mt, head=True)
+            self._forward_stripes(cst, rids, [head_entry], mt,
+                                  head=True, region=region)
         for cst, rids in groups:
-            self._forward_stripes(cst, rids, tails, mt)
+            self._forward_stripes(cst, rids, tails, mt, region=region)
 
     def _forward_stripes(self, conn: _Conn, receivers: List[int],
                          entries: List[tuple], msg_type,
-                         head: bool = False) -> None:
+                         head: bool = False, region=None) -> None:
         """Enqueue one segment of a logical frame's stripe sequence
         atomically (all or nothing) onto the PRE-RESOLVED connection
         ``receivers`` share: an over-bound queue drops the whole
@@ -845,7 +1066,12 @@ class TcpHub:
         index gap (tail dropped after its head) or nothing at all, and
         either way the logical frame dies with straggler semantics
         instead of wedging reassembly (a gap aborts the stream; a head
-        with no tail is evicted by the bounded-stream cap)."""
+        with no tail is evicted by the bounded-stream cap).
+
+        ``region``: the stripe chunks are views over ONE laned payload
+        — each queued entry carries its own retain() (the drain
+        releases per entry), so the slab bytes live exactly as long as
+        the last undrained stripe anywhere."""
         nbytes = sum(e[3] for e in entries)
         wake = False
         dropped = False
@@ -854,7 +1080,10 @@ class TcpHub:
         # ids — the drain's rebind re-check needs them; the buffers
         # themselves stay shared across connections
         rids = tuple(receivers)
-        tagged = [(e[0], e[1], e[2], e[3], rids) for e in entries]
+        tagged = [(e[0], e[1], e[2], e[3], rids, region) for e in entries]
+        if region is not None:
+            for _ in entries:
+                region.retain()
         with self._lock:
             st = conn
             if st.dead:
@@ -872,6 +1101,9 @@ class TcpHub:
                     st.scheduled = True
                     wake = True
         if dropped:
+            if region is not None:
+                for _ in entries:
+                    region.release()
             for r in receivers:
                 self._count_drop(r, msg_type)
             return
@@ -907,13 +1139,15 @@ class TcpHub:
                 live_nodes = None  # filtered mux/stripe-0 target list
                 stale_subset: Tuple = ()
                 dead_leftovers = None
+                region = None
                 with self._lock:
                     if st.dead:
                         # replaced/deregistered: frames die with it —
                         # COUNTED, like the OSError path's leftovers
                         # (the rebind policy promises visible drops)
-                        dead_leftovers = [(e[0], e[4]) for e in st.heads]
-                        dead_leftovers += [(e[0], e[4])
+                        dead_leftovers = [(e[0], e[4], e[5])
+                                          for e in st.heads]
+                        dead_leftovers += [(e[0], e[4], e[5])
                                            for e in st.frames]
                         st.heads.clear()
                         st.frames.clear()
@@ -922,7 +1156,7 @@ class TcpHub:
                         # strict priority, quantum-exempt: heads are
                         # small and the head-start contract wants all
                         # of them out before any conn's tail
-                        msg_type, parts, hdr, nbytes, rids = \
+                        msg_type, parts, hdr, nbytes, rids, region = \
                             st.heads.popleft()
                         st.nbytes -= nbytes
                         from_head = True
@@ -932,7 +1166,7 @@ class TcpHub:
                     elif quantum >= self._pace:
                         requeue = True
                     else:
-                        msg_type, parts, hdr, nbytes, rids = \
+                        msg_type, parts, hdr, nbytes, rids, region = \
                             st.frames.popleft()
                         st.nbytes -= nbytes
                     if not requeue and dead_leftovers is None:
@@ -946,8 +1180,12 @@ class TcpHub:
                         # get it FILTERED to the live subset (the
                         # outer header is rebuilt at drain anyway);
                         # whole entries drop only when every target is
-                        # gone.
-                        if rids:
+                        # gone.  Range-claim conns are exempt: their
+                        # cohort is an atom (displacement kills the
+                        # whole conn via ``st.dead`` above, never a
+                        # single id), so ``st.ids`` being empty must
+                        # not read as "everything stale".
+                        if rids and not st.ranges:
                             stale_subset = tuple(
                                 r for r in rids if r not in st.ids)
                             if len(stale_subset) == len(rids):
@@ -957,9 +1195,11 @@ class TcpHub:
                                 live_nodes = [r for r in rids
                                               if r in st.ids]
                 if dead_leftovers is not None:
-                    for mt_, rids_ in dead_leftovers:
+                    for mt_, rids_, reg_ in dead_leftovers:
                         for r in rids_ or ():
                             self._count_drop(r, mt_)
+                        if reg_ is not None:
+                            reg_.release()
                     break
                 if requeue:
                     self._ready.put((nid, st))
@@ -971,6 +1211,8 @@ class TcpHub:
                 quantum = self._pace if from_head else quantum + 1
                 if stale_rids:
                     # every id this entry addressed was rebound away
+                    if region is not None:
+                        region.release()
                     for r in rids:
                         self._count_drop(r, msg_type)
                     continue
@@ -1046,20 +1288,26 @@ class TcpHub:
                     # dead receiver: count this frame + everything still
                     # queued, deregister (its reader thread finishes
                     # cleanup when it sees EOF)
+                    if region is not None:
+                        region.release()
                     self._count_drop(nid, msg_type)
                     with self._lock:
                         st.dead = True
                         for i in list(st.ids):
                             if self._conns.get(i) is st:
                                 self._conns.pop(i, None)
-                        leftovers = [(e[0], e[4]) for e in st.heads]
-                        leftovers += [(e[0], e[4]) for e in st.frames]
+                        leftovers = [(e[0], e[4], e[5])
+                                     for e in st.heads]
+                        leftovers += [(e[0], e[4], e[5])
+                                      for e in st.frames]
                         st.heads.clear()
                         st.frames.clear()
                         st.nbytes = 0
-                    for mt_, rids_ in leftovers:
+                    for mt_, rids_, reg_ in leftovers:
                         for r in rids_ or (nid,):
                             self._count_drop(r, mt_)
+                        if reg_ is not None:
+                            reg_.release()
                     break
                 except Exception:
                     # never lose a pool worker to an unexpected bug —
@@ -1070,8 +1318,14 @@ class TcpHub:
                     # receiver (worse than the bug being survived)
                     logging.exception("hub: sender worker error for "
                                       "node %s", nid)
+                    if region is not None:
+                        region.release()
                     self._count_drop(nid, msg_type)
                     continue
+                if region is not None:
+                    # sent: this entry's slab pin dies here — when the
+                    # LAST queue's copy drains, the ring reclaims
+                    region.release()
 
     def _conn_send(self, st: _Conn, hdr_dict, line, body, msg_type) -> None:
         """Write one frame to a connection: header line on the socket,
@@ -1131,6 +1385,7 @@ class TcpHub:
             "shm_frames": self.shm_frames,
             "shm_bytes": self.shm_bytes,
             "shm_fallbacks": self.shm_fallbacks,
+            "shm_hub_copies": self.shm_hub_copies,
         }
 
     def stats(self) -> dict:
@@ -1140,13 +1395,16 @@ class TcpHub:
         sockets — equal for v1 dialers, many-to-one under muxing."""
         with self._lock:
             snap = self._counters_snapshot()
-            snap["nodes"] = len(self._conns)
+            snap["nodes"] = len(self._conns) + sum(
+                rc.claimed() for rc in self._range_conns)
             conns = set(map(id, self._conns.values()))
+            conns.update(map(id, self._range_conns))
             snap["connections"] = len(conns)
             snap["shm_conns"] = len(
                 {id(c) for c in self._conns.values()
                  if c.lane is not None}
             )
+            snap["range_conns"] = len(self._range_conns)
         return snap
 
     def sample_telemetry(self, telemetry=None) -> dict:
@@ -1167,11 +1425,12 @@ class TcpHub:
         t = telemetry or get_telemetry()
         with self._lock:
             depths = {}
-            nodes_total = len(self._conns)
+            nodes_total = len(self._conns) + sum(
+                rc.claimed() for rc in self._range_conns)
             shm_conns = 0
-            for st in set(self._conns.values()):
+            for st in set(self._conns.values()) | set(self._range_conns):
                 depths[st.cid] = (len(st.frames) + len(st.heads),
-                                  st.nbytes, len(st.ids))
+                                  st.nbytes, st.claimed())
                 if st.lane is not None:
                     shm_conns += 1
             snap = self._counters_snapshot()
@@ -1839,7 +2098,18 @@ class TcpBackend(CommBackend):
         want = set(int(i) for i in ids)
         for reply in self._sync_hub_replies("peers", timeout,
                                             "await_peers"):
-            if want <= set(reply.get("ids", [])):
+            have = set(reply.get("ids", []))
+            missing = want - have
+            if missing:
+                # range-claim cohorts (edge uplinks) are reported as
+                # [lo, hi] spans — check the remainder against them
+                # without materializing the span
+                for lo, hi in reply.get("ranges", ()):
+                    missing = {m for m in missing
+                               if not (lo <= m <= hi)}
+                    if not missing:
+                        break
+            if not missing:
                 return
             _time.sleep(0.05)  # poll: resuming re-sends the request
         raise TimeoutError(
@@ -2103,7 +2373,8 @@ class TcpBackend(CommBackend):
                     # the reassembled frame fans out to locally
                     ent = {"chunks": [], "next": 0, "total": total,
                            "t0": t_now, "nbytes": 0, "blen": 0, "mt": mt,
-                           "nodes": frame.get("nodes")}
+                           "nodes": frame.get("nodes"),
+                           "range": frame.get("range")}
                     self._reasm[sid] = ent
                 if idx != ent["next"] or total != ent["total"]:
                     abort_reason = "gap"

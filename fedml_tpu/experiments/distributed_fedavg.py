@@ -251,6 +251,9 @@ def run_hub(host: str, port: int, run_dir: str = "",
                  shm_min_bytes=shm_min_bytes)
     # announce the bound port on stdout for the launcher
     print(json.dumps({"hub_port": hub.port}), flush=True)
+    from fedml_tpu.obs.telemetry import get_telemetry
+
+    get_telemetry().gauge_set("hub.tier", 0)
     stop = {"flag": False}
 
     def _stop(*_):
@@ -282,6 +285,36 @@ def run_hub(host: str, port: int, run_dir: str = "",
         # hub-side fault accounting for the launcher (dropped frames by
         # message type — chaos runs reconcile these against injections)
         print(json.dumps({"hub_stats": hub.stats()}), flush=True)
+
+
+def _defense_from_args(args):
+    """Build the DefenseConfig (or None = the exact undefended path)
+    from the CLI knobs — shared by the root server and the edge-hub
+    tier, which must screen uploads with the IDENTICAL configuration
+    for the tree-vs-flat byte-identity pin to hold."""
+    if args.trim_frac != 0.2 and args.defense != "trimmed_mean":
+        # DefenseConfig cannot tell an explicit 0.2 from the default,
+        # so the only layer that knows the flag was TYPED is this one —
+        # a trim fraction without its mode must not be silently inert
+        raise SystemExit(
+            "--trim-frac only applies with --defense trimmed_mean "
+            f"(got --defense {args.defense})"
+        )
+    if (args.defense != "none" or args.dp_clip > 0 or args.dp_noise > 0
+            or args.norm_bound > 0 or args.outlier_mult > 0
+            or args.conn_cap > 0):
+        # ANY defense knob constructs the config, so a knob that needs
+        # a mode it wasn't given fails DefenseConfig validation loudly
+        # instead of running a silently-undefended federation
+        from fedml_tpu.robust import DefenseConfig
+
+        return DefenseConfig(
+            defense=args.defense, norm_bound=args.norm_bound,
+            outlier_mult=args.outlier_mult, conn_cap=args.conn_cap,
+            dp_clip=args.dp_clip, dp_noise=args.dp_noise,
+            trim_frac=args.trim_frac,
+        )
+    return None
 
 
 def run_server(args) -> None:
@@ -327,29 +360,7 @@ def run_server(args) -> None:
     # robust aggregation (fedml_tpu/robust): --defense picks the mode,
     # the numeric knobs parametrize it; all-defaults = None = the exact
     # undefended code path
-    defense = None
-    if args.trim_frac != 0.2 and args.defense != "trimmed_mean":
-        # DefenseConfig cannot tell an explicit 0.2 from the default,
-        # so the only layer that knows the flag was TYPED is this one —
-        # a trim fraction without its mode must not be silently inert
-        raise SystemExit(
-            "--trim-frac only applies with --defense trimmed_mean "
-            f"(got --defense {args.defense})"
-        )
-    if (args.defense != "none" or args.dp_clip > 0 or args.dp_noise > 0
-            or args.norm_bound > 0 or args.outlier_mult > 0
-            or args.conn_cap > 0):
-        # ANY defense knob constructs the config, so a knob that needs
-        # a mode it wasn't given fails DefenseConfig validation loudly
-        # instead of running a silently-undefended federation
-        from fedml_tpu.robust import DefenseConfig
-
-        defense = DefenseConfig(
-            defense=args.defense, norm_bound=args.norm_bound,
-            outlier_mult=args.outlier_mult, conn_cap=args.conn_cap,
-            dp_clip=args.dp_clip, dp_noise=args.dp_noise,
-            trim_frac=args.trim_frac,
-        )
+    defense = _defense_from_args(args)
     server = FedAvgServerManager(
         backend, init, num_clients=args.num_clients,
         clients_per_round=args.clients_per_round or args.num_clients,
@@ -586,6 +597,81 @@ def run_muxer(args) -> None:
         }), flush=True)
 
 
+def run_edge_hub(args) -> None:
+    """ONE edge tier of the hierarchical aggregation tree: a LOCAL hub
+    terminating the downstream cohort (node ids ``--node-id ..
+    --node-id + --virtual-clients - 1``), the streaming partial fold,
+    and one uplink connection to the root — the root's connection and
+    fold load both shrink from O(clients) to O(edges)."""
+    _force_cpu_if_requested()
+    from fedml_tpu.algorithms.edge_hub import EdgeHubManager
+    from fedml_tpu.comm.edge import EdgeUplinkBackend
+    from fedml_tpu.comm.tcp import TcpHub
+    from fedml_tpu.obs.telemetry import get_telemetry
+
+    ds, bundle, init, lu = _build_problem(args.seed, args.num_clients,
+                                          args.input_dim, args.train_samples)
+    node_ids = list(range(args.node_id,
+                          args.node_id + max(1, args.virtual_clients)))
+    # the local tier is a full hub: stripes/shm lanes are PER-TIER
+    # decisions, so each broadcast crosses each leg's wire exactly once
+    # with that leg's own fan-out machinery
+    hub = TcpHub("127.0.0.1", 0,
+                 stripe_bytes=(args.stripe_kib << 10)
+                 if args.fanout == "striped" else 0,
+                 max_inflight_stripes=args.stripe_pace,
+                 shm_min_bytes=args.shm_min_bytes)
+    # announce the local port for the launcher's downstream workers
+    print(json.dumps({"edge_port": hub.port}), flush=True)
+    get_telemetry().gauge_set("hub.tier", 1)
+    local = _connect_backend(0, "127.0.0.1", hub.port, wire=args.wire,
+                             **_lane_kwargs(args))
+    # downstream barrier BEFORE dialing the root: the uplink hello
+    # claims the whole cohort's ids, which satisfies the root server's
+    # startup barrier — so the cohort must actually be registered down
+    # here first, or INIT would re-fan into dropped frames
+    local.await_peers(node_ids, timeout=60 + 15 * len(node_ids))
+    reconnect = args.auto_reconnect if args.auto_reconnect >= 0 else 3
+    uplink = _dial_with_retry(
+        lambda: EdgeUplinkBackend(node_ids, args.host, args.port,
+                                  auto_reconnect=reconnect, wire=args.wire,
+                                  **_lane_kwargs(args)))
+    mgr = EdgeHubManager(
+        uplink, local, hub, init,
+        round_timeout=args.round_timeout or None,
+        decode_workers=(args.decode_workers
+                        if args.hotpath == "fast" else 0),
+        defense=_defense_from_args(args), seed=args.seed,
+        delta_base_window=args.delta_base_window,
+        crash_at_round=(args.crash_at_round
+                        if args.crash_at_round >= 0 else None),
+    )
+    mlog = _node_metrics_logger(args.run_dir, f"edge{args.node_id}")
+    _install_flight(args.run_dir, f"edge{args.node_id}")
+    stop_flusher = _start_event_flusher(mlog)
+    mgr.start()
+    mgr.run()  # blocks until FINISH drains + tears the tier down
+    stop_flusher()
+    if mlog is not None:
+        mlog.log_telemetry()
+        mlog.close()
+    # per-edge accounting for the launcher/campaign (folded vs
+    # forwarded-raw is the tree's composition evidence; peak RSS and
+    # the local hub's churn counters let fed_tree_run/fed_scale_run
+    # attribute memory and rebinds to the correct tier)
+    import resource
+
+    stats = mgr.stats()
+    stats["peak_rss_kb"] = resource.getrusage(
+        resource.RUSAGE_SELF).ru_maxrss
+    try:
+        stats["local_hub"] = hub.stats()
+    except Exception:
+        pass
+    print(json.dumps({f"edge_{args.node_id}_stats": stats}),
+          flush=True)
+
+
 def launch(
     num_clients: int = 3,
     rounds: int = 2,
@@ -606,6 +692,9 @@ def launch(
     muxers: int = 0,
     muxed_clients: int = 0,
     crash_muxer_at_round: int = -1,
+    topology: str = "flat",
+    edge_hubs: int = 0,
+    crash_edge_hub_at_round: int = -1,
     chaos_plan: str = "",
     codec: str = "none",
     wire: int = 2,
@@ -679,6 +768,22 @@ def launch(
     dialers on one hub).  ``crash_muxer_at_round`` hard-exits the FIRST
     muxer when that round's sync arrives — hundreds of virtual clients
     vanish at once (the ``muxer_crash`` chaos scenario).
+
+    Hierarchical aggregation (``topology="tree"``, ``edge_hubs=E``):
+    the sampled id space is partitioned contiguously into E edge-hub
+    cohorts — WHOLE worker processes (a muxer and its full virtual
+    range, or a per-process client) are assigned to exactly one edge —
+    and each cohort's workers dial their edge's LOCAL hub instead of
+    the root.  The edge folds its cohort's uploads into one partial
+    aggregate per round (``algorithms/edge_hub``), so the root sees E
+    connections and E folds instead of O(clients); the fp64 num/den
+    partials compose exactly, so the final model is byte-identical to
+    the flat run's.  Idle clients stay on the root hub (they are never
+    sampled — pure connection load, which is the root's job to carry).
+    ``crash_edge_hub_at_round`` hard-exits the FIRST edge hub when that
+    round's sync arrives — a whole cohort orphaned at once (the
+    ``edge_hub_crash`` chaos scenario; the round degrades visibly and
+    the federation finishes NaN-free on the survivors).
     """
     env = dict(env or os.environ)
     if server_env is not None:
@@ -762,49 +867,124 @@ def launch(
             common += ["--spares", str(spares)]
         if auto_reconnect:
             common += ["--auto-reconnect", str(auto_reconnect)]
+        # robust-aggregation knobs: the server's decision — and in tree
+        # topology ALSO each edge hub's, because per-upload screening
+        # runs at the edge with the identical config (clients stay
+        # oblivious either way)
+        defense_flags = []
+        if defense != "none":
+            defense_flags += ["--defense", defense]
+        for flag, val, dflt in (("--norm-bound", norm_bound, 0.0),
+                                ("--outlier-mult", outlier_mult, 0.0),
+                                ("--conn-cap", conn_cap, 0.0),
+                                ("--dp-clip", dp_clip, 0.0),
+                                ("--dp-noise", dp_noise, 0.0),
+                                ("--trim-frac", trim_frac, 0.2)):
+            if val != dflt:
+                defense_flags += [flag, str(val)]
+        # worker units in node-id order: each is an indivisible PROCESS
+        # (a muxer owns its whole contiguous virtual-id range), which
+        # is the granularity the tree partition assigns to edges
         muxed = 0
-        mux_procs = []
+        mux_specs = []
         if muxers:
             muxed = min(muxed_clients or num_clients, num_clients)
             base_sz, rem = divmod(muxed, muxers)
             start = 1
             for j in range(muxers):
                 size = base_sz + (1 if j < rem else 0)
-                if size <= 0:
-                    continue
-                mux_procs.append(subprocess.Popen(
-                    me + ["--role", "muxer", "--node-id", str(start),
-                          "--virtual-clients", str(size)] + common
-                    + (["--rejoin-every-round"]
-                       if mux_rejoin_every_round else [])
-                    + (["--crash-at-round", str(crash_muxer_at_round)]
-                       if crash_muxer_at_round >= 0 and j == 0 else []),
-                    env=env,
-                    # muxer stdout carries one upload-digest JSON line
-                    # PER virtual client — digest comparisons against a
-                    # per-process run are topology-blind
-                    stdout=subprocess.PIPE if info is not None else None,
-                    text=True if info is not None else None,
-                ))
-                start += size
-        procs += mux_procs
-        clients = [
-            subprocess.Popen(
-                me + ["--role", "client", "--node-id", str(i + 1)] + common
-                + (["--train-delay", str(slow_client_delay)]
-                   if slow_client_delay and i == num_clients - 1 else [])
-                + (["--crash-at-round", str(crash_client_at_round)]
-                   if crash_client_at_round >= 0 and i == num_clients - 1
-                   else []),
-                env=env,
-                # client stdout carries the upload-digest JSON line the
-                # compression measurement compares across re-runs
-                stdout=subprocess.PIPE if info is not None else None,
-                text=True if info is not None else None,
-            )
-            for i in range(muxed, num_clients)
-        ]
-        procs += clients
+                if size > 0:
+                    mux_specs.append((start, size))
+                    start += size
+        units = [("muxer", s, sz) for s, sz in mux_specs] \
+            + [("client", i + 1, 1) for i in range(muxed, num_clients)]
+        use_tree = topology == "tree" and edge_hubs > 0
+        if use_tree:
+            # contiguous partition balanced by client count: a unit
+            # lands in the group whose proportional share its ids fall
+            # into, so whole muxers never straddle an edge boundary
+            tree_groups = [[] for _ in range(edge_hubs)]
+            acc, gi = 0, 0
+            for u in units:
+                tree_groups[gi].append(u)
+                acc += u[2]
+                if (gi < edge_hubs - 1
+                        and acc >= (gi + 1) * num_clients / edge_hubs):
+                    gi += 1
+            groups = [g for g in tree_groups if g]
+        else:
+            groups = [units] if units else []
+        mux_procs = []
+        clients = []
+        edge_procs = []
+        tier_flags = ["--fanout", fanout,
+                      "--stripe-kib", str(stripe_kib),
+                      "--stripe-pace", str(stripe_pace)]
+        for gi, group in enumerate(groups):
+            wport = port
+            if use_tree:
+                first = group[0][1]
+                count = sum(u[2] for u in group)
+                ep = subprocess.Popen(
+                    me + ["--role", "edge_hub", "--node-id", str(first),
+                          "--virtual-clients", str(count)]
+                    + common + defense_flags + tier_flags
+                    + (["--crash-at-round", str(crash_edge_hub_at_round)]
+                       if crash_edge_hub_at_round >= 0 and gi == 0
+                       else []),
+                    stdout=subprocess.PIPE, text=True, env=env,
+                )
+                edge_procs.append(ep)
+                line = ep.stdout.readline()
+                if not line:
+                    raise RuntimeError(
+                        f"edge hub {gi} died before announcing its port")
+                wport = json.loads(line)["edge_port"]
+            # the cohort dials ITS tier's hub: a trailing --port
+            # overrides the root port baked into `common` (argparse
+            # keeps the last occurrence)
+            port_override = ([] if wport == port
+                             else ["--port", str(wport)])
+            for kind, start, size in group:
+                if kind == "muxer":
+                    mux_procs.append(subprocess.Popen(
+                        me + ["--role", "muxer", "--node-id", str(start),
+                              "--virtual-clients", str(size)] + common
+                        + port_override
+                        + (["--rejoin-every-round"]
+                           if mux_rejoin_every_round else [])
+                        + (["--crash-at-round", str(crash_muxer_at_round)]
+                           if crash_muxer_at_round >= 0 and mux_specs
+                           and (start, size) == mux_specs[0] else []),
+                        env=env,
+                        # muxer stdout carries one upload-digest JSON
+                        # line PER virtual client — digest comparisons
+                        # against a per-process run are topology-blind
+                        stdout=(subprocess.PIPE if info is not None
+                                else None),
+                        text=True if info is not None else None,
+                    ))
+                else:
+                    clients.append(subprocess.Popen(
+                        me + ["--role", "client",
+                              "--node-id", str(start)] + common
+                        + port_override
+                        + (["--train-delay", str(slow_client_delay)]
+                           if slow_client_delay and start == num_clients
+                           else [])
+                        + (["--crash-at-round",
+                            str(crash_client_at_round)]
+                           if crash_client_at_round >= 0
+                           and start == num_clients else []),
+                        env=env,
+                        # client stdout carries the upload-digest JSON
+                        # line the compression measurement compares
+                        # across re-runs
+                        stdout=(subprocess.PIPE if info is not None
+                                else None),
+                        text=True if info is not None else None,
+                    ))
+        procs += mux_procs + clients + edge_procs
         idle = [
             subprocess.Popen(
                 me + ["--role", "client",
@@ -818,19 +998,6 @@ def launch(
         # clients — e.g. aggregation on the one real TPU chip while 16
         # client processes train on CPU (only one process may hold the
         # tunnel lease)
-        # robust-aggregation knobs ride the SERVER invocation only (the
-        # defense is a server-side decision; clients stay oblivious)
-        defense_flags = []
-        if defense != "none":
-            defense_flags += ["--defense", defense]
-        for flag, val, dflt in (("--norm-bound", norm_bound, 0.0),
-                                ("--outlier-mult", outlier_mult, 0.0),
-                                ("--conn-cap", conn_cap, 0.0),
-                                ("--dp-clip", dp_clip, 0.0),
-                                ("--dp-noise", dp_noise, 0.0),
-                                ("--trim-frac", trim_frac, 0.2)):
-            if val != dflt:
-                defense_flags += [flag, str(val)]
         server = subprocess.Popen(
             me + ["--role", "server", "--out", out_path] + common
             + defense_flags,
@@ -893,7 +1060,7 @@ def launch(
         rc = server.wait(timeout=timeout)
         if info is not None:
             _collect_json_lines(server.stdout, info)
-        for c in clients + mux_procs:
+        for c in clients + mux_procs + edge_procs:
             out = None
             try:
                 if c.stdout is not None:
@@ -936,7 +1103,9 @@ def launch(
 
 def main(argv=None):
     p = argparse.ArgumentParser()
-    p.add_argument("--role", choices=["hub", "server", "client", "muxer"],
+    p.add_argument("--role",
+                   choices=["hub", "server", "client", "muxer",
+                            "edge_hub"],
                    required=True)
     p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, required=True)
@@ -1059,6 +1228,8 @@ def main(argv=None):
         run_server(args)
     elif args.role == "muxer":
         run_muxer(args)
+    elif args.role == "edge_hub":
+        run_edge_hub(args)
     else:
         run_client(args)
 

@@ -179,8 +179,14 @@ class FlightRecorder:
             else:
                 self.record("spans", name, v=value)
 
-    def _on_lock(self, name: str, depth: int) -> None:
-        self.record("locks", "acquire", lock=name, depth=depth)
+    def _on_lock(self, name: str, depth: int,
+                 wait_s: float = 0.0) -> None:
+        # ``wait_s`` is the CheckedLock tap's measured block time: the
+        # lock-wait ring doubles as a contention profile (fed_forensics
+        # ranks locks by total/max wait) — rows with wait_s == 0 are
+        # uncontended acquires and still chart acquisition ORDER
+        self.record("locks", "acquire", lock=name, depth=depth,
+                    wait_s=wait_s)
 
     # -- configuration ------------------------------------------------------
     def configure(self, run_dir: Optional[str], node: str) -> None:

@@ -45,6 +45,12 @@ COUNTERS = {
     "comm.delta_bcast_bytes": "encoded bytes of delta-mode broadcast payloads",
     "comm.delta_full_fallbacks": "delta-mode broadcasts that shipped the full model {reason=}",
     "comm.delta_resyncs": "full-resync requests after an inapplicable delta sync",
+    "comm.shm_hub_copies": "laned inbound payloads the hub materialized instead of pinning {reason=}",
+    "edge.folded_uploads": "uploads an edge hub folded into its partial aggregate",
+    "edge.uplink_bytes": "wire bytes of E2S_PARTIAL frames an edge hub sent upstream",
+    "edge.uplink_frames": "E2S_PARTIAL frames an edge hub sent upstream {reason=}",
+    "edge.flat_fallbacks": "uploads an edge hub forwarded upstream raw instead of folding {reason=}",
+    "edge.partials_folded": "E2S_PARTIAL frames the root folded into the round accumulator",
     "hub.mcast_frames": "mcast control frames fanned out by the hub {msg_type=}",
     "hub.dropped_frames": "frames to unregistered/dead/over-bound receivers {msg_type=}",
     "hub.node_rebinds": "node ids re-claimed by a newer connection (new conn wins)",
@@ -70,6 +76,7 @@ COUNTERS = {
 
 # --- gauges (instantaneous, or cumulative with _total; gauge_set/max) --------
 GAUGES = {
+    "hub.tier": "aggregation-tree tier of this process's hub (0=root, 1=edge)",
     "hub.connections": "physical hub connections (== nodes for v1 dialers)",
     "hub.nodes": "registered node ids (>= connections under muxing)",
     "hub.send_queue_frames": "per-connection outbound queue depth {conn=}",

@@ -448,3 +448,60 @@ def test_crashed_client_leaves_parseable_bundle_ci_pin(tmp_path):
     v = fed_forensics.analyze(str(tmp_path))
     assert v["fault_kind"] == "client_crash"
     assert v["fault_round"] == 1
+
+
+# --- lock-contention probe (CheckedLock wait tap) ----------------------------
+
+def test_lock_wait_tap_records_edge_fold_contention(tmp_path):
+    """The CheckedLock tap measures BLOCK time and feeds the flight
+    recorder's lock ring: under forced contention the edge hub's fold
+    lock shows up with a nonzero wait_s, and fed_forensics ranks it."""
+    import threading
+
+    from fedml_tpu.analysis import locks as locks_mod
+
+    r = _fresh(tmp_path, tag="edge5")
+    locks_mod.set_enabled(True)
+    try:
+        locks_mod.set_acquire_tap(r._on_lock)
+        # the REAL production lock: a stub-backed manager, so the name
+        # asserted below is the one EdgeHubManager actually creates
+        from fedml_tpu.algorithms.edge_hub import EdgeHubManager
+
+        class _StubBackend:
+            node_id = 5
+            node_ids = [5, 6]
+
+            def add_observer(self, obs):
+                pass
+
+        mgr = EdgeHubManager(_StubBackend(), _StubBackend(), None, None)
+        lock = mgr._fold_lock
+        assert isinstance(lock, locks_mod.CheckedLock)
+        entered = threading.Event()
+
+        def holder():
+            with lock:
+                entered.set()
+                time.sleep(0.08)
+
+        t = threading.Thread(target=holder, daemon=True)
+        t.start()
+        assert entered.wait(5)
+        with lock:  # blocks behind the holder: a measured, real wait
+            pass
+        t.join(5)
+    finally:
+        locks_mod.set_acquire_tap(None)
+        locks_mod.set_enabled(None)
+    b = json.loads(Path(r.dump("manual", force=True)).read_text())
+    rows = [row for row in b["rings"]["locks"]
+            if row.get("lock") == "EdgeHubManager._fold_lock"]
+    assert rows, "fold-lock acquires never reached the lock-wait ring"
+    assert max(float(row.get("wait_s") or 0) for row in rows) >= 0.05
+    top = fed_forensics.lock_contention({"edge5": b})
+    ent = [e for e in top if e["lock"] == "EdgeHubManager._fold_lock"]
+    assert ent, f"fold lock missing from contention ranking: {top}"
+    assert ent[0]["contended"] >= 1
+    assert ent[0]["wait_max_s"] >= 0.05
+    assert ent[0]["acquires"] >= 2

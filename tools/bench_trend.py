@@ -39,6 +39,7 @@ PREFIXES = (
     "BENCH_", "FEDLAT_", "FEDSCALE_", "FEDTRACE_", "FEDHEALTH_",
     "FAULTS_", "CONVERGENCE_", "COMPRESS_", "MULTICHIP_", "SCALING_",
     "FEDERATION_", "ROBUST_", "FEDXPORT_", "FEDCHURN_", "FEDFLIGHT_",
+    "FEDTREE_",
 )
 
 _ROUND_RE = re.compile(r"[_-]r(\d+)")
@@ -177,6 +178,25 @@ def _extract(doc: dict, fname: str) -> dict:
             ok = _deep_get(doc, f"{k}.ok")
             if ok is not None:
                 out[f"ok[{k}]"] = bool(ok)
+    elif fname.startswith("FEDTREE_"):
+        ladder = doc.get("ladder")
+        if isinstance(ladder, list) and ladder:
+            # headline = the LARGEST ladder point (the scale claim)
+            pt = max((p for p in ladder if isinstance(p, dict)),
+                     key=lambda p: p.get("clients") or 0, default=None)
+            if pt:
+                out["clients"] = _num(pt.get("clients"))
+                out["root_rss_ratio"] = _num(
+                    pt.get("root_rss_ratio_tree_vs_flat"))
+                out["p50_factor"] = _num(pt.get("p50_factor_tree_vs_flat"))
+                v = _num(_deep_get(pt, "tree.round_wall_s.p50"))
+                if v is not None:
+                    out["tree_p50"] = v
+        ok = _deep_get(doc, "digest_pin.ok")
+        if ok is not None:
+            out["ok[digest_pin]"] = bool(ok)
+        if doc.get("ok") is not None:
+            out["ok"] = bool(doc["ok"])
     elif fname.startswith("FEDCHURN_"):
         v = _num(_deep_get(doc, "churn.node_rebinds"))
         if v is not None:
@@ -262,6 +282,9 @@ GATE_RULES = {
     "FEDXPORT_": ({"p50[*": "lower", "delta_bytes_ratio": "lower",
                    "ok[*": "true"}, 0.15),
     "FEDCHURN_": ({"hub_rss_mb": "lower", "ok": "true"}, 0.20),
+    "FEDTREE_": ({"root_rss_ratio": "lower", "p50_factor": "lower",
+                  "clients": "higher", "ok": "true",
+                  "ok[*": "true"}, 0.15),
     "FAULTS_": ({"survived": "higher", "all_nan_free": "true"}, 0.0),
     "ROBUST_": ({"defended_acc_at_30pct": "higher", "ok": "true"}, 0.05),
     "CONVERGENCE_": ({"acc*": "higher"}, 0.05),

@@ -53,10 +53,21 @@ round with a finite global model.  Default matrix:
                          the hub's lane detach must look exactly like a
                          dropped connection — survivors aggregate,
                          degraded rounds, never a wedged slab
+    edge_hub_crash       two-tier topology: the FIRST edge hub
+                         os._exit()s when round 1's sync arrives — a
+                         whole cohort (its local hub, its partial fold,
+                         its uplink) vanishes in one SIGKILL-shaped
+                         event; the root's deadline closes the round on
+                         the surviving edge's partials, degradation
+                         visible, NaN-free to the final round
 
     ``--lane shm`` / ``--bcast delta`` re-run the WHOLE matrix over the
     new transport path (FEDXPORT acceptance: all prior scenarios
-    NaN-free over shm+delta).
+    NaN-free over shm+delta); ``--topology tree --edge-hubs N`` re-runs
+    it over the hierarchical aggregation tree (PR 17 acceptance: every
+    fault mode that held flat must hold with an edge tier terminating
+    the cohort — scenario-pinned keys still win, so edge_hub_crash is
+    a tree run even in the default flat matrix).
 
 Per scenario the output records: survived, rounds completed, rounds
 aggregated empty (``zero_participant_rounds``), degraded rounds,
@@ -275,6 +286,19 @@ def _scenarios(round_timeout: float, num_clients: int = 3):
             "crash_muxer_at_round": 1,
             "round_timeout": round_timeout,
         },
+        # the FIRST edge hub of a two-edge tree hard-exits when round
+        # 1's sync arrives: its whole cohort is orphaned at once (their
+        # local hub died under them — reconnects dial a dead port).
+        # The root must close every later round by deadline on the
+        # surviving edge's partials: degraded rounds, finite model,
+        # rc=0.  Topology keys are pinned HERE so the scenario is a
+        # tree run even inside the default flat matrix.
+        "edge_hub_crash": {
+            "topology": "tree",
+            "edge_hubs": 2,
+            "crash_edge_hub_at_round": 1,
+            "round_timeout": round_timeout,
+        },
     }
 
 
@@ -438,6 +462,10 @@ def main(argv=None) -> int:
     p.add_argument("--lane", choices=["tcp", "shm"], default="tcp")
     p.add_argument("--bcast", choices=["full", "delta"], default="full")
     p.add_argument("--shm-min-bytes", type=int, default=0)
+    # topology override: soak the whole matrix over the hierarchical
+    # aggregation tree (PR 17) — scenario-pinned keys still win
+    p.add_argument("--topology", choices=["flat", "tree"], default="flat")
+    p.add_argument("--edge-hubs", type=int, default=2)
     args = p.parse_args(argv)
 
     scenarios = _scenarios(args.round_timeout, args.num_clients)
@@ -454,6 +482,9 @@ def main(argv=None) -> int:
         transport["shm_min_bytes"] = args.shm_min_bytes
     if args.bcast != "full":
         transport["bcast"] = args.bcast
+    if args.topology == "tree":
+        transport["topology"] = "tree"
+        transport["edge_hubs"] = args.edge_hubs
 
     results = []
     for name, kwargs in scenarios.items():

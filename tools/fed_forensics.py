@@ -541,6 +541,40 @@ def diff_rounds(profiles: Dict[int, dict], bad: Optional[int],
     return {"round": bad, "vs_round": ref, "metrics": metrics}
 
 
+# -- lock contention --------------------------------------------------------
+
+def lock_contention(bundles: Dict[str, dict]) -> List[dict]:
+    """Rank locks by recorded wait time across every bundle's ``locks``
+    ring (the CheckedLock tap's ``wait_s`` measurements — present only
+    when the run had ``FEDML_TPU_CHECKED_LOCKS=1``).  A hot aggregation
+    lock shows up here as nonzero total/max wait with the owning
+    process tag, instead of as a wall-time hunch."""
+    agg: Dict[tuple, dict] = {}
+    for tag, b in bundles.items():
+        rings = b.get("rings") or {}
+        for row in rings.get("locks", ()):
+            name = row.get("lock")
+            if not name:
+                continue
+            w = row.get("wait_s")
+            w = float(w) if isinstance(w, (int, float)) else 0.0
+            ent = agg.setdefault((tag, name), {
+                "tag": tag, "lock": name, "acquires": 0,
+                "contended": 0, "wait_total_s": 0.0, "wait_max_s": 0.0,
+            })
+            ent["acquires"] += 1
+            if w > 1e-4:  # >100 us of blocking = a real contention event
+                ent["contended"] += 1
+            ent["wait_total_s"] += w
+            ent["wait_max_s"] = max(ent["wait_max_s"], w)
+    out = sorted(agg.values(),
+                 key=lambda e: (-e["wait_total_s"], e["tag"], e["lock"]))
+    for e in out:
+        e["wait_total_s"] = round(e["wait_total_s"], 6)
+        e["wait_max_s"] = round(e["wait_max_s"], 6)
+    return out[:24]
+
+
 # -- perfetto ---------------------------------------------------------------
 
 def to_perfetto(bundles: Dict[str, dict], clock: Clock) -> dict:
@@ -638,6 +672,7 @@ def analyze(run_dir: str) -> dict:
         ],
         "round_profiles": {str(r): p for r, p in profiles.items()},
         "round_diff": diff_rounds(profiles, v["fault_round"], anomalous),
+        "lock_contention": lock_contention(bundles),
     })
     return doc
 

@@ -106,7 +106,8 @@ def run_scale_federation(clients: int, muxers: int, rounds: int,
                          *, seed: int, batch_size: int,
                          round_timeout: float, timeout: float,
                          extra_flags=(), run_dir: str = "",
-                         info=None) -> dict:
+                         info=None, topology: str = "flat",
+                         edge_hubs: int = 0) -> dict:
     """Hub + server + M muxers as OS processes, hub peak RSS recorded.
 
     A local orchestrator rather than ``launch()``: the hub's pid is
@@ -118,7 +119,14 @@ def run_scale_federation(clients: int, muxers: int, rounds: int,
     every role's command line (e.g. ``--stats-plane off``, ``--slo``),
     ``run_dir`` turns on per-process metrics files + the server's
     status/slo artifacts, and ``info`` (a dict) collects the server's
-    final stdout JSON (stats-plane stream counts, fault counters)."""
+    final stdout JSON (stats-plane stream counts, fault counters).
+
+    ``topology="tree"`` + ``edge_hubs=E`` interposes the hierarchical
+    aggregation tier (PR 17): worker units are partitioned contiguously
+    into E cohorts, each behind its own ``--role edge_hub`` process,
+    and the root hub sees E uplink connections instead of O(clients).
+    Each edge's exit stats (partial-fold counters, peak RSS, its local
+    hub's churn counters) land in ``info`` as ``edge_<id>_stats``."""
     me = [sys.executable, "-m", "fedml_tpu.experiments.distributed_fedavg"]
     env = _env()
     out_path = os.path.join(tempfile.mkdtemp(prefix="fedscale_"),
@@ -143,21 +151,63 @@ def run_scale_federation(clients: int, muxers: int, rounds: int,
         if run_dir:
             common += ["--run-dir", run_dir]
         devnull = subprocess.DEVNULL  # 10k digest lines are not evidence here
+        units = []
         if muxers:
             base_sz, rem = divmod(clients, muxers)
             start = 1
             for j in range(muxers):
                 size = base_sz + (1 if j < rem else 0)
-                procs.append(subprocess.Popen(
-                    me + ["--role", "muxer", "--node-id", str(start),
-                          "--virtual-clients", str(size)] + common,
-                    env=env, stdout=devnull))
-                start += size
+                if size > 0:
+                    units.append(("muxer", start, size))
+                    start += size
         else:
-            for i in range(clients):
-                procs.append(subprocess.Popen(
-                    me + ["--role", "client", "--node-id", str(i + 1)]
-                    + common, env=env, stdout=devnull))
+            units = [("client", i + 1, 1) for i in range(clients)]
+        use_tree = topology == "tree" and edge_hubs > 0
+        if use_tree:
+            # the same contiguous client-count partition launch() uses:
+            # whole worker processes (a muxer and its virtual range)
+            # are indivisible, so they never straddle an edge boundary
+            tree_groups = [[] for _ in range(edge_hubs)]
+            acc, gi = 0, 0
+            for u in units:
+                tree_groups[gi].append(u)
+                acc += u[2]
+                if (gi < edge_hubs - 1
+                        and acc >= (gi + 1) * clients / edge_hubs):
+                    gi += 1
+            groups = [g for g in tree_groups if g]
+        else:
+            groups = [units] if units else []
+        edge_procs = []
+        for group in groups:
+            wport = port
+            if use_tree:
+                first = group[0][1]
+                count = sum(u[2] for u in group)
+                ep = subprocess.Popen(
+                    me + ["--role", "edge_hub", "--node-id", str(first),
+                          "--virtual-clients", str(count)] + common,
+                    stdout=subprocess.PIPE, text=True, env=env)
+                procs.append(ep)
+                edge_procs.append(ep)
+                line = ep.stdout.readline()
+                if not line:
+                    raise RuntimeError(
+                        "edge hub died before announcing its port")
+                wport = json.loads(line)["edge_port"]
+            # trailing --port override dials the cohort's own tier
+            # (argparse keeps the last occurrence)
+            override = [] if wport == port else ["--port", str(wport)]
+            for kind, start, size in group:
+                if kind == "muxer":
+                    procs.append(subprocess.Popen(
+                        me + ["--role", "muxer", "--node-id", str(start),
+                              "--virtual-clients", str(size)]
+                        + common + override, env=env, stdout=devnull))
+                else:
+                    procs.append(subprocess.Popen(
+                        me + ["--role", "client", "--node-id", str(start)]
+                        + common + override, env=env, stdout=devnull))
         server = subprocess.Popen(
             me + ["--role", "server", "--out", out_path] + common,
             env=env,
@@ -171,6 +221,23 @@ def run_scale_federation(clients: int, muxers: int, rounds: int,
                     info.update(json.loads(line))
                 except json.JSONDecodeError:
                     continue
+        edge_stats = {}
+        for ep in edge_procs:
+            # each edge exits on its own after the FINISH drain and
+            # prints one stats JSON line (fold counters, peak RSS,
+            # local-hub churn) — the tree's per-tier evidence
+            try:
+                out, _ = ep.communicate(timeout=30)
+            except subprocess.TimeoutExpired:
+                ep.kill()
+                out = None
+            for line in (out or "").splitlines():
+                try:
+                    edge_stats.update(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+        if info is not None:
+            info.update(edge_stats)
         # peak RSS is a high-water mark: reading it AFTER the run (hub
         # still alive) captures the whole federation's pressure
         hub_peak_kb = _vm_kb(hub.pid, "VmHWM")
@@ -189,10 +256,15 @@ def run_scale_federation(clients: int, muxers: int, rounds: int,
                         continue
             except subprocess.TimeoutExpired:
                 hub.kill()
+        edge_rss = [round(v.get("peak_rss_kb", 0) / 1024.0, 1)
+                    for v in edge_stats.values() if isinstance(v, dict)]
         return {
             "clients": clients,
             "muxers": muxers,
-            "processes": 2 + (muxers or clients),
+            "topology": topology if use_tree else "flat",
+            "edge_hubs": len(edge_procs),
+            "edge_peak_rss_mb": edge_rss,
+            "processes": 2 + (muxers or clients) + len(edge_procs),
             "rc": rc,
             "rounds": rounds_done,
             "nan_free": finite,
@@ -232,7 +304,11 @@ def run_scale(args) -> dict:
     big = run_scale_federation(
         args.clients, args.muxers, args.rounds, seed=args.seed,
         batch_size=args.batch_size, round_timeout=args.round_timeout,
-        timeout=args.timeout)
+        timeout=args.timeout,
+        topology=getattr(args, "topology", "flat"),
+        edge_hubs=(getattr(args, "edge_hubs", 0)
+                   if getattr(args, "topology", "flat") == "tree"
+                   else 0))
     print(json.dumps(big), flush=True)
     ratio = (big["hub_peak_rss_mb"] / ref["hub_peak_rss_mb"]
              if ref["hub_peak_rss_mb"] else None)
@@ -265,6 +341,12 @@ def run_churn(args) -> dict:
       full-model path (``comm.delta_full_fallbacks`` resync/no_ack > 0);
     - hub peak RSS stays bounded (churn must not leak connections,
       queues, or slabs).
+
+    Over ``--topology tree`` the rejoin-every-round muxers dial their
+    EDGE hub, so the rebind churn lands on the edge tier (counted in
+    each ``edge_<id>_stats.local_hub.node_rebinds``) while the root's
+    uplink connections stay stable — the tree absorbing connection
+    churn at the tier that terminates it is exactly the scaling claim.
     """
     _barrier()
     info: dict = {}
@@ -272,14 +354,19 @@ def run_churn(args) -> dict:
              "--auto-reconnect", "1000", "--shm-min-bytes", "0"]
     if args.lane != "tcp":
         flags += ["--lane", args.lane]
+    use_tree = getattr(args, "topology", "flat") == "tree"
     print(f"== churn soak: {args.churn_clients} virtual clients on "
           f"{args.churn_muxers} rejoin-every-round muxers, "
-          f"{args.churn_rounds} rounds ==", flush=True)
+          f"{args.churn_rounds} rounds"
+          + (f", {args.edge_hubs} edge hubs" if use_tree else "")
+          + " ==", flush=True)
     res = run_scale_federation(
         args.churn_clients, args.churn_muxers, args.churn_rounds,
         seed=args.seed, batch_size=args.batch_size,
         round_timeout=args.churn_round_timeout, timeout=args.timeout,
-        extra_flags=flags, info=info)
+        extra_flags=flags, info=info,
+        topology=getattr(args, "topology", "flat"),
+        edge_hubs=getattr(args, "edge_hubs", 0) if use_tree else 0)
     print(json.dumps(res), flush=True)
     hub_stats = info.get("hub_stats") or {}
     faults = info.get("faults") or {}
@@ -287,10 +374,18 @@ def run_churn(args) -> dict:
                  for k, v in faults.items()
                  if k.startswith("comm.delta_full_fallbacks")}
     rebinds = hub_stats.get("node_rebinds", 0)
+    if use_tree:
+        # the churn terminates at the edge tier: count rebinds there
+        rebinds = sum(
+            (v.get("local_hub") or {}).get("node_rebinds", 0)
+            for k, v in info.items()
+            if k.startswith("edge_") and k.endswith("_stats")
+            and isinstance(v, dict))
     min_rebinds = args.churn_muxers * max(1, args.churn_rounds - 2)
     return {
         "run": res,
         "lane": args.lane,
+        "topology": "tree" if use_tree else "flat",
         "node_rebinds": rebinds,
         "delta_full_fallbacks": fallbacks,
         "hub_stats": hub_stats,
@@ -423,12 +518,19 @@ def main(argv=None) -> int:
     p.add_argument("--train-samples", type=int, default=16)
     p.add_argument("--big-clients", type=int, default=256)
     p.add_argument("--big-muxers", type=int, default=1)
-    # churn knobs (PR 13): muxers re-hello every round over --lane
+    # churn knobs (PR 13; PR 17 raises the default to "high virtual
+    # counts" — the PR-10 leftover — and adds the tree topology):
+    # muxers re-hello every round over --lane
     p.add_argument("--lane", choices=["tcp", "shm"], default="shm")
-    p.add_argument("--churn-clients", type=int, default=32)
+    p.add_argument("--churn-clients", type=int, default=512)
     p.add_argument("--churn-muxers", type=int, default=2)
     p.add_argument("--churn-rounds", type=int, default=5)
-    p.add_argument("--churn-round-timeout", type=float, default=20.0)
+    p.add_argument("--churn-round-timeout", type=float, default=60.0)
+    # topology knobs (PR 17): run scale/churn over the hierarchical
+    # aggregation tree — worker cohorts behind --edge-hubs edge tiers
+    p.add_argument("--topology", choices=["flat", "tree"],
+                   default="flat")
+    p.add_argument("--edge-hubs", type=int, default=2)
     args = p.parse_args(argv)
 
     artifact = {

@@ -1,0 +1,204 @@
+#!/usr/bin/env python
+"""FEDTREE campaign driver (PR 17): the hierarchical-aggregation scale
+proof, 100k toward 1M virtual clients on one box.
+
+Three arms, one artifact (``FEDTREE_r17.json``):
+
+1. **Digest pin** — a small tree federation vs the flat topology at the
+   same seed: every per-client upload digest byte-identical and the
+   final global model bit-equal (sha256 over the leaf bytes).  The
+   num/den partial composes exactly, so the tree must be invisible in
+   the bytes — the same acceptance shape PR 10 pinned for
+   muxed-vs-per-process.
+2. **Scale ladder** — at each virtual-client count: the flat topology
+   (M muxers on the root hub) vs the tree (same M muxers behind E edge
+   hubs).  Reported per point: root-hub peak RSS, p50 round wall,
+   rounds completed, NaN-freedom, per-edge fold counters.
+3. **Bars, pre-declared** — root-hub peak RSS below the flat run's at
+   the same count; p50 round wall within ``--p50-factor`` (default
+   1.5x) of flat; >= 3 rounds NaN-free.  ``ok`` is the AND across the
+   ladder.
+
+The ladder runs tiny per-client problems (``--train-samples 2``, 8-dim
+model) because the claim under test is TOPOLOGY cost — connection
+count, fold serialization, routing memory at the root — not training
+throughput; PR 10 established the cohort engine's compute story.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tools.fed_scale_run import (  # noqa: E402
+    _barrier, _env, run_scale_federation,
+)
+
+
+def _model_digest(npz_path: str) -> str:
+    import numpy as np
+
+    z = np.load(npz_path)
+    h = hashlib.sha256()
+    for k in sorted(z.files):
+        if k.startswith("leaf_"):
+            h.update(np.ascontiguousarray(z[k]).tobytes())
+    return h.hexdigest()
+
+
+def run_pin(args) -> dict:
+    """Tree-vs-flat byte-identity at full participation: upload digests
+    equal per client, final model sha256 equal."""
+    from fedml_tpu.experiments.distributed_fedavg import launch
+
+    res = {}
+    for tag, tree in (("flat", False), ("tree", True)):
+        _barrier()
+        out = os.path.join(tempfile.mkdtemp(prefix=f"fedtree_pin_{tag}_"),
+                           "final.npz")
+        info: dict = {}
+        kw = dict(topology="tree", edge_hubs=args.edge_hubs) if tree else {}
+        rc = launch(num_clients=args.pin_clients, rounds=args.rounds,
+                    seed=args.seed, batch_size=args.batch_size,
+                    out_path=out, muxers=args.pin_muxers,
+                    env=_env(), info=info, timeout=600.0, **kw)
+        digests = {k: v for k, v in sorted(info.items())
+                   if k.endswith("_upload_digest")}
+        res[tag] = {"rc": rc, "upload_digests": digests,
+                    "model_sha256": (_model_digest(out)
+                                     if os.path.exists(out) else None)}
+    pin_ok = bool(
+        res["flat"]["rc"] == 0 and res["tree"]["rc"] == 0
+        and len(res["flat"]["upload_digests"]) == args.pin_clients
+        and res["flat"]["upload_digests"] == res["tree"]["upload_digests"]
+        and res["flat"]["model_sha256"] is not None
+        and res["flat"]["model_sha256"] == res["tree"]["model_sha256"])
+    print(json.dumps({"pin_ok": pin_ok,
+                      "model_sha256": res["flat"]["model_sha256"]}),
+          flush=True)
+    # the full digest maps are bulky and redundant once compared —
+    # keep counts + equality verdicts, drop the bodies
+    for tag in res:
+        res[tag]["upload_digests"] = len(res[tag]["upload_digests"])
+    return {"clients": args.pin_clients, "muxers": args.pin_muxers,
+            "edge_hubs": args.edge_hubs, "rounds": args.rounds,
+            "runs": res, "ok": pin_ok}
+
+
+def run_point(args, clients: int) -> dict:
+    """One ladder point: flat then tree at the same virtual count."""
+    flags = ["--train-samples", str(args.train_samples)]
+    point = {"clients": clients, "muxers": args.muxers,
+             "edge_hubs": args.edge_hubs}
+    for tag in ("flat", "tree"):
+        _barrier()
+        print(f"== {clients} virtual clients / {tag} ==", flush=True)
+        info: dict = {}
+        r = run_scale_federation(
+            clients, args.muxers, args.rounds, seed=args.seed,
+            batch_size=args.batch_size,
+            round_timeout=args.round_timeout, timeout=args.timeout,
+            extra_flags=flags, info=info,
+            topology=tag, edge_hubs=(args.edge_hubs
+                                     if tag == "tree" else 0))
+        if tag == "tree":
+            r["edge_stats"] = {
+                k: v for k, v in info.items()
+                if k.startswith("edge_") and k.endswith("_stats")}
+        r.pop("out_path", None)
+        point[tag] = r
+        print(json.dumps({tag: {"rc": r["rc"], "rounds": r["rounds"],
+                                "hub_peak_rss_mb": r["hub_peak_rss_mb"],
+                                "p50": r["round_wall_s"]["p50"],
+                                "wall_s": r["wall_s"]}}), flush=True)
+    flat, tree = point["flat"], point["tree"]
+    rss_ratio = (tree["hub_peak_rss_mb"] / flat["hub_peak_rss_mb"]
+                 if flat["hub_peak_rss_mb"] else None)
+    p50_f, p50_t = flat["round_wall_s"]["p50"], tree["round_wall_s"]["p50"]
+    p50_factor = (p50_t / p50_f if (p50_f and p50_t) else None)
+    folded = sum(
+        (v or {}).get("folded_uploads", 0)
+        for v in (tree.get("edge_stats") or {}).values()
+        if isinstance(v, dict))
+    fallbacks = sum(
+        (v or {}).get("flat_fallbacks", 0)
+        for v in (tree.get("edge_stats") or {}).values()
+        if isinstance(v, dict))
+    point.update({
+        "root_rss_ratio_tree_vs_flat": (round(rss_ratio, 3)
+                                        if rss_ratio is not None else None),
+        "p50_factor_tree_vs_flat": (round(p50_factor, 3)
+                                    if p50_factor is not None else None),
+        "edge_folded_uploads": folded,
+        "edge_flat_fallbacks": fallbacks,
+        "ok": bool(
+            flat["rc"] == 0 and tree["rc"] == 0
+            and flat["nan_free"] and tree["nan_free"]
+            and tree["rounds"] >= args.rounds
+            and rss_ratio is not None and rss_ratio < 1.0
+            and p50_factor is not None
+            and p50_factor <= args.p50_factor),
+    })
+    return point
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="FEDTREE_r17.json")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--rounds", type=int, default=3)
+    p.add_argument("--clients-ladder", default="100000",
+                   help="comma-separated virtual-client counts "
+                        "(the ISSUE regime: 100000 toward 1000000)")
+    p.add_argument("--muxers", type=int, default=8)
+    p.add_argument("--edge-hubs", type=int, default=4)
+    p.add_argument("--train-samples", type=int, default=2)
+    p.add_argument("--round-timeout", type=float, default=900.0)
+    p.add_argument("--timeout", type=float, default=3600.0)
+    p.add_argument("--p50-factor", type=float, default=1.5,
+                   help="pre-declared bar: tree p50 round wall must be "
+                        "within this factor of flat's")
+    p.add_argument("--pin-clients", type=int, default=64)
+    p.add_argument("--pin-muxers", type=int, default=2)
+    p.add_argument("--skip-pin", action="store_true")
+    args = p.parse_args(argv)
+
+    ladder = [int(x) for x in args.clients_ladder.split(",") if x]
+    artifact = {
+        "experiment": (
+            "hierarchical edge-hub aggregation tree: root-hub RSS and "
+            "p50 round wall vs the flat topology at the same virtual-"
+            "client count, plus the tree-vs-flat byte-identity pin"
+        ),
+        "generated_unix": round(time.time(), 1),
+        "thresholds_pre_declared": {
+            "root_rss_ratio_max": 1.0,
+            "p50_factor_max": args.p50_factor,
+            "min_rounds": args.rounds,
+            "min_clients": 100_000,
+        },
+    }
+    ok = True
+    if not args.skip_pin:
+        artifact["digest_pin"] = run_pin(args)
+        ok = ok and artifact["digest_pin"]["ok"]
+    artifact["ladder"] = [run_point(args, c) for c in ladder]
+    ok = ok and all(pt["ok"] for pt in artifact["ladder"])
+    ok = ok and max(ladder, default=0) >= 100_000
+    artifact["ok"] = ok
+    with open(args.out, "w") as fh:
+        json.dump(artifact, fh, indent=1, default=float)
+    print(json.dumps({"out": args.out, "ok": ok}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

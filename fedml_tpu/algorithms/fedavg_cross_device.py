@@ -57,6 +57,7 @@ from fedml_tpu.core import tree as treelib
 # Absent key = legacy fp32 full-model uploads — old peers interop.
 MSG_ARG_KEY_CODEC = "codec"
 from fedml_tpu.core.client import LocalUpdateFn
+from fedml_tpu.core.staleness import STALENESS_POLICIES, staleness_weight
 from fedml_tpu.core.types import FedDataset, pack_clients
 from fedml_tpu.obs import flight
 from fedml_tpu.obs.telemetry import get_telemetry
@@ -359,6 +360,7 @@ class FedAvgServerManager(NodeManager):
         "pending": "_round_lock",
         "_acked": "_ack_lock",
         "_delta_log": "_ack_lock",
+        "_model_log": "_round_lock",
         "_agg_acc": "_round_lock",
         "_agg_n": "_round_lock",
         "_conn_acc": "_round_lock",
@@ -396,6 +398,11 @@ class FedAvgServerManager(NodeManager):
         bcast: str = "full",
         bcast_codec: str = "",
         delta_base_window: int = 4,
+        round_mode: str = "sync",
+        cut_size: int = 0,
+        max_staleness: int = 2,
+        stale_policy: str = "poly",
+        stale_alpha: float = 0.5,
     ):
         from fedml_tpu.compress import get_codec
 
@@ -466,6 +473,45 @@ class FedAvgServerManager(NodeManager):
                 "conn_cap requires the streaming hot path "
                 "(streaming_agg=True / --hotpath fast)"
             )
+        # async buffered rounds (``--round-mode async``): FedBuff-style
+        # fold-on-arrival — the round is CUT at every ``cut_size``
+        # arrivals (or the cut deadline) instead of barrier-closed, and
+        # an upload computed against base round b < r folds in at
+        # weight w(r-b)·n (``core/staleness``) instead of being
+        # stale-rejected.  ``max_staleness`` keeps the reject firewall
+        # as the hard outer bound.  Requires the streaming fold: the
+        # O(1) accumulator is WHAT arrivals fold into mid-round — the
+        # legacy buffered path and buffered robust estimators have no
+        # partial-round state to discount into.
+        if round_mode not in ("sync", "async"):
+            raise ValueError(
+                f"unknown round_mode {round_mode!r} (sync|async)"
+            )
+        self.round_mode = round_mode
+        self._async = round_mode == "async"
+        if self._async and (not self.streaming_agg
+                            or self._defense_buffered):
+            raise ValueError(
+                "round_mode='async' requires the streaming fold "
+                "(streaming_agg=True and a streaming-composable "
+                "defense) — buffered closes cannot discount a "
+                "partial-round accumulator"
+            )
+        if stale_policy not in STALENESS_POLICIES:
+            raise ValueError(
+                f"unknown stale_policy {stale_policy!r} "
+                f"(one of {STALENESS_POLICIES})"
+            )
+        self.max_staleness = max(0, int(max_staleness))
+        self.stale_policy = stale_policy
+        self.stale_alpha = float(stale_alpha)
+        # cut target: K arrivals per round cut (0 = the sync cohort
+        # size).  Capped at the broadcast size — a larger cut could
+        # never fill before the deadline.
+        if self._async and cut_size > 0:
+            self._cut_target = min(int(cut_size), self.broadcast_size)
+        else:
+            self._cut_target = self.clients_per_round
         # delta/dedup broadcast (``--bcast delta``): consecutive rounds'
         # models differ by exactly one aggregated update, so the sync
         # ships the int8-encoded UPDATE against each connection's
@@ -511,6 +557,14 @@ class FedAvgServerManager(NodeManager):
 
         self._acked: Dict[int, int] = {}
         self._delta_log: "OrderedDict[int, dict]" = OrderedDict()
+        # async mode's base-model log: round r -> the model round r
+        # BROADCAST, bounded to the staleness window — a stale upload
+        # decodes (and robust-screens) against the base its client
+        # actually trained from, not the current model.  Round 0's
+        # base is the init.
+        self._model_log: "OrderedDict[int, object]" = OrderedDict()
+        if self._async:
+            self._model_log[0] = init_variables
         # ((round_idx, id(variables)), wire): the current model encoded
         # at most once per round however many full sends need it
         self._full_wire_cache = None
@@ -724,7 +778,7 @@ class FedAvgServerManager(NodeManager):
         self._bytes_mark = total_bytes
         self.slo.observe_round(
             self.round_idx, wall_s=wall, round_bytes=round_bytes,
-            participants=len(self.pending), target=self.clients_per_round,
+            participants=len(self.pending), target=self._cut_target,
         )
         self._fold_local_digest()
         self.slo.evaluate(
@@ -953,8 +1007,8 @@ class FedAvgServerManager(NodeManager):
             self._full_wire_cache = cached
         return cached[1]
 
-    def _advance_chain(self, prev_model,
-                       next_round: Optional[int] = None) -> None:  # fedlint: holds=_round_lock
+    def _advance_chain(self, prev_model,  # fedlint: holds=_round_lock
+                       next_round: Optional[int] = None) -> None:
         """Close-time half of the delta broadcast (caller holds the
         round lock): U = aggregate − M_r + residual, encoded on the
         seeded broadcast stream; M_{r+1} := M_r + decode(encode(U));
@@ -986,6 +1040,14 @@ class FedAvgServerManager(NodeManager):
             lambda u, d: u - np.asarray(d, np.float32), raw, decoded
         )
         self.variables = apply_bcast_delta(prev_model, decoded)
+        if self._async:
+            # chain mode's model-log record: the advanced model IS what
+            # round next_round broadcasts (the close-path record is
+            # skipped when a chain is on — a deferred advance would
+            # otherwise log the pre-advance model)
+            self._model_log[next_round] = self.variables
+            while len(self._model_log) > self.max_staleness + 1:
+                self._model_log.popitem(last=False)
         with self._ack_lock:
             self._delta_log[next_round] = wire
             while len(self._delta_log) > self.delta_base_window:
@@ -1074,8 +1136,18 @@ class FedAvgServerManager(NodeManager):
         """Caller holds the round lock.  Discard a straggler's upload
         from an already-closed round: aggregating it into the CURRENT
         round would double-count its stale parameters (missing round
-        index = legacy client, accepted as current)."""
+        index = legacy client, accepted as current).
+
+        Async mode keeps this as the hard OUTER bound only: an upload
+        up to ``max_staleness`` rounds behind folds in discounted
+        (``_stale_weight``); beyond the window — or claiming a FUTURE
+        round — it is rejected exactly like the sync barrier would."""
         assert_held(self._round_lock, "FedAvgServerManager._is_stale")
+        if (self._async and reply_round is not None
+                and reply_round != self.round_idx):
+            d = self.round_idx - int(reply_round)
+            if 0 < d <= self.max_staleness:
+                return False  # in-window: discounted at fold, not dropped
         if reply_round is not None and reply_round != self.round_idx:
             self.round_log.append(
                 {"round": self.round_idx, "stale_from": msg.sender,
@@ -1087,6 +1159,28 @@ class FedAvgServerManager(NodeManager):
                                 msg_type=MSG_TYPE_C2S_SEND_MODEL)
             return True
         return False
+
+    def _stale_weight(self, delta: int) -> float:
+        """w(r-b) for an in-window async upload — the shared np|jnp
+        formula from ``core/staleness`` at xp=np (host fold path).
+        delta<=0 short-circuits to exactly 1.0 so the current-round
+        fast path never even builds an array."""
+        if delta <= 0:
+            return 1.0
+        return float(staleness_weight(
+            delta, self.stale_policy, alpha=self.stale_alpha,
+            window=self.max_staleness, xp=np,
+        ))
+
+    def _staleness_delta(self, reply_round) -> int:  # fedlint: holds=_round_lock
+        """Round gap of an accepted upload (0 = current / legacy
+        no-echo), read under the round lock so it is consistent with
+        the cut this fold lands in."""
+        assert_held(self._round_lock,
+                    "FedAvgServerManager._staleness_delta")
+        if not self._async or reply_round is None:
+            return 0
+        return max(0, self.round_idx - int(reply_round))
 
     def _on_resync(self, msg: Message) -> None:
         """A client received a delta against a base it no longer holds
@@ -1146,8 +1240,15 @@ class FedAvgServerManager(NodeManager):
             # delta uploads reconstruct against the model THIS round
             # broadcast — capture it under the lock (a concurrent round
             # close would swap self.variables; the post-decode stale
-            # re-check then discards anything decoded against it)
-            base = self.variables
+            # re-check then discards anything decoded against it).
+            # Async: an in-window stale upload decodes against the base
+            # its client trained from (the model log keeps the last
+            # max_staleness+1 broadcast models).
+            if self._async and reply_round is not None:
+                base = self._model_log.get(int(reply_round),
+                                           self.variables)
+            else:
+                base = self.variables
         if self._decode_pool is not None:
             # pipeline: hand decode+fold to the worker pool and free
             # the reader thread for the next frame — decode of upload i
@@ -1244,8 +1345,6 @@ class FedAvgServerManager(NodeManager):
             # closing chain's (round_close reads them under this lock)
             self._last_decode_wait_s = wait_s
             self._last_decode_s = decode_s
-            meta = {"n": n,
-                    "metrics": msg.get(MSG_ARG_KEY_LOCAL_METRICS) or {}}
             if msg.sender in self.pending:
                 # duplicate upload (chaos duplicate / redelivery): the
                 # buffered path overwrote the entry idempotently, but a
@@ -1256,6 +1355,25 @@ class FedAvgServerManager(NodeManager):
                                     kind="duplicate_upload",
                                     msg_type=MSG_TYPE_C2S_SEND_MODEL)
                 return
+            # async staleness discount (post-duplicate-check, so a
+            # redelivered copy never double-counts the async series):
+            # an in-window upload d rounds behind folds at weight
+            # w(d)·n.  d==0 keeps n UNTOUCHED (no multiply) — the
+            # fp-exactness the async≡sync byte-identity pin rests on.
+            d = self._staleness_delta(reply_round)
+            if self._async:
+                tel = get_telemetry()
+                tel.observe("async.upload_staleness", float(d))
+                if d > 0:
+                    w = self._stale_weight(d)
+                    tel.inc("async.stale_weighted_uploads")
+                    if w != 1.0:
+                        n_w = float(np.float64(w) * np.float64(n))
+                        tel.inc("async.discarded_weight", n - n_w)
+                        n = n_w
+                tel.inc("async.folded_weight", n)
+            meta = {"n": n,
+                    "metrics": msg.get(MSG_ARG_KEY_LOCAL_METRICS) or {}}
             if self._robust is not None:
                 # defense telemetry counts ACCEPTED uploads only —
                 # after the duplicate check above, so a redelivered
@@ -1298,7 +1416,7 @@ class FedAvgServerManager(NodeManager):
                     )
                 meta["variables"] = variables
             self.pending[msg.sender] = meta
-            if len(self.pending) < self.clients_per_round:
+            if len(self.pending) < self._cut_target:
                 return
             try:
                 self._close_round()
@@ -1474,6 +1592,28 @@ class FedAvgServerManager(NodeManager):
                 tel.inc("faults.observed", kind="duplicate_upload",
                         msg_type=MSG_TYPE_E2S_PARTIAL)
                 return
+            # async per-tier discount: the edge tagged this partial
+            # with ITS base round, so a partial d rounds behind scales
+            # num AND den by w(d) — the num/den ratio (the models) is
+            # untouched; only this tier's vote shrinks.  Gated on
+            # w != 1.0 so the current-round path stays fp-exact.
+            d = self._staleness_delta(reply_round)
+            if self._async:
+                tel.observe("async.upload_staleness", float(d))
+                if d > 0:
+                    w = self._stale_weight(d)
+                    tel.inc("async.stale_weighted_uploads", len(contrib))
+                    if w != 1.0:
+                        w64 = np.float64(w)
+                        num = jax.tree_util.tree_map(
+                            lambda l: l * w64, num
+                        )
+                        den_w = float(w64 * np.float64(den))
+                        tel.inc("async.discarded_weight", den - den_w)
+                        den = den_w
+                        contrib = {k: float(w64 * np.float64(v))
+                                   for k, v in contrib.items()}
+                tel.inc("async.folded_weight", den)
             t0 = time.perf_counter()
             if self._conn_cap > 0:
                 # contribution caps over the tree: each partial carries
@@ -1504,7 +1644,7 @@ class FedAvgServerManager(NodeManager):
             tel.inc("edge.partials_folded")
             for node in sorted(contrib):
                 self.pending[node] = {"n": contrib[node], "metrics": {}}
-            if len(self.pending) < self.clients_per_round:
+            if len(self.pending) < self._cut_target:
                 return
             try:
                 self._close_round()
@@ -1626,7 +1766,7 @@ class FedAvgServerManager(NodeManager):
                "t_open_m": round(self._round_open_t, 6),
                "t_close_m": round(t_close_m, 6)}
         missing = sorted(sampled - set(self.pending))
-        if len(self.pending) >= self.clients_per_round:
+        if len(self.pending) >= self._cut_target:
             # the round closed at its K-report target: unreported nodes
             # are over-sampled spares whose hedge wasn't needed — NOT
             # dropouts (logging them as 'dropped' would make a healthy
@@ -1643,7 +1783,12 @@ class FedAvgServerManager(NodeManager):
         # series a chaos soak reads next to span.reconnect_s
         tel.observe("span.server_round_s",
                     max(0.0, time.perf_counter() - self._round_open_t))
-        if len(self.pending) < self.clients_per_round:
+        if self._async:
+            # every async close is a CUT (K arrivals or the cut
+            # deadline) — the series fed_timeline/fed_slo read the
+            # cut cadence from
+            tel.inc("async.cut_rounds")
+        if len(self.pending) < self._cut_target:
             # degraded: fewer reporters than the aggregation target
             # (deadline cut, crashes, dropped frames) — same counter
             # series the simulation drivers increment, so one number
@@ -1703,6 +1848,12 @@ class FedAvgServerManager(NodeManager):
         self._conn_acc, self._conn_n = {}, {}
         self._last_decode_wait_s = self._last_decode_s = 0.0
         self.round_idx += 1
+        if self._async and not self._chain:
+            # base-model log for the new round's broadcast (chain mode
+            # records inside _advance_chain — see there)
+            self._model_log[self.round_idx] = self.variables
+            while len(self._model_log) > self.max_staleness + 1:
+                self._model_log.popitem(last=False)
         if self.round_idx >= self.comm_rounds:
             nodes = list(range(1, self.num_clients + 1))
             if self.multicast:
@@ -1848,7 +1999,14 @@ class FedAvgClientManager(NodeManager):
         train_delay: float = 0.0,
         crash_at_round: Optional[int] = None,
         error_feedback: bool = True,
+        traffic=None,
     ):
+        # open-loop traffic model (faults/traffic.TrafficModel): this
+        # client draws a seeded per-round arrival decision — offline
+        # rounds are skipped (churn), delays sleep before training so
+        # the upload arrives on the device's own clock.  None = the
+        # closed-loop behavior, unchanged.
+        self.traffic = traffic
         self.local_update = jax.jit(local_update.fn)
         self.dataset = dataset
         self.batch_size = batch_size
@@ -1915,6 +2073,24 @@ class FedAvgClientManager(NodeManager):
             import time
 
             time.sleep(self.train_delay)
+        if self.traffic is not None:
+            r = msg.get(MSG_ARG_KEY_ROUND_INDEX)
+            if r is not None:
+                tel = get_telemetry()
+                d = self.traffic.decide(self.backend.node_id, int(r))
+                if d["offline"]:
+                    # churned out this round: no training, no upload —
+                    # the server's deadline (or async cut) covers it
+                    tel.inc("traffic.offline_rounds")
+                    return
+                if d["straggler"]:
+                    tel.inc("traffic.straggler_draws")
+                if d["delay_s"] > 0.0:
+                    import time
+
+                    tel.inc("traffic.delayed_uploads")
+                    tel.observe("traffic.upload_delay_s", d["delay_s"])
+                    time.sleep(d["delay_s"])
         variables = self._reconstruct_sync(msg)
         if variables is None:
             return  # inapplicable delta: resync requested, round skipped

@@ -73,6 +73,7 @@ from fedml_tpu.comm.mux import TcpMuxBackend
 from fedml_tpu.core.client import LocalUpdateFn
 from fedml_tpu.core.types import FedDataset, pack_clients
 from fedml_tpu.obs import flight
+from fedml_tpu.obs.telemetry import get_telemetry
 
 
 class _VirtualEndpoint(NodeManager):
@@ -148,8 +149,16 @@ class FedAvgMuxClientManager:
         crash_at_round: Optional[int] = None,
         wrap_backend: Optional[Callable[[CommBackend], CommBackend]] = None,
         rejoin_every_round: bool = False,
+        traffic=None,
     ):
         self.mux = mux
+        # open-loop traffic model (faults/traffic.TrafficModel): every
+        # virtual client gets its own seeded per-round arrival decision
+        # — offline (churn), per-upload delay (speed class + jitter +
+        # heavy-tailed straggler), and a connection-level flap draw.
+        # None = the closed-loop behavior, byte-identical to before.
+        self.traffic = traffic
+        self._last_traffic_round = -1
         # connection-churn soak knob: after every trained round this
         # muxer drops its hub connection (auto_reconnect re-dials and
         # re-helloes — the hub's rebind counters grow) AND forgets its
@@ -291,6 +300,26 @@ class FedAvgMuxClientManager:
                     "drop_connection", self.mux.node_id,
                 )
                 self.mux.drop_connection()
+        if (self.traffic is not None and not self._finished.is_set()
+                and batch_round is not None
+                and batch_round > self._last_traffic_round):
+            # traffic-model flap: connection-granularity (one physical
+            # socket per muxer), keyed by the PRIMARY virtual node so
+            # the draw is independent of cohort composition.  Same
+            # once-per-round guard as the churn soak — a resync
+            # walkback's per-node flushes must not flap repeatedly.
+            self._last_traffic_round = batch_round
+            if self.traffic.decide(
+                    self.mux.node_ids[0], batch_round)["rebind"]:
+                get_telemetry().inc("traffic.rebinds")
+                try:
+                    self.mux.rebind_connection()
+                except (OSError, ConnectionError):
+                    logging.exception(
+                        "muxer %d: traffic flap re-dial failed; falling "
+                        "back to drop_connection", self.mux.node_id,
+                    )
+                    self.mux.drop_connection()
         if self.crash_at_round is not None and any(
             m.get(MSG_ARG_KEY_ROUND_INDEX) == self.crash_at_round
             for _, m in pending
@@ -356,10 +385,30 @@ class FedAvgMuxClientManager:
     def _train_cohort(self, ref_msg: Message, entries: List[tuple]) -> bool:  # fedlint: holds=_train_lock
         entries = sorted(entries, key=lambda e: e[0])
         round_idx = ref_msg.get(MSG_ARG_KEY_ROUND_INDEX)
+        # open-loop arrivals: each virtual client draws its own seeded
+        # traffic decision for this round — offline nodes drop out of
+        # the cohort (join/leave churn; the server's deadline/async cut
+        # covers them), the rest carry a per-upload delay (speed class
+        # x jitter x straggler tail) applied at send time so a slow
+        # device's upload ARRIVES late without stalling the cohort.
+        decisions: Dict[int, dict] = {}
+        if self.traffic is not None and round_idx is not None:
+            tel = get_telemetry()
+            kept = []
+            for node, msg in entries:
+                d = self.traffic.decide(node, round_idx)
+                if d["offline"]:
+                    tel.inc("traffic.offline_rounds")
+                    continue
+                if d["straggler"]:
+                    tel.inc("traffic.straggler_draws")
+                decisions[node] = d
+                kept.append((node, msg))
+            entries = kept
+            if not entries:
+                return False
         variables = self._reconstruct_sync(ref_msg)
         if variables is None:
-            from fedml_tpu.obs.telemetry import get_telemetry
-
             get_telemetry().inc("comm.delta_resyncs",
                                 len(entries))
             logging.warning(
@@ -454,13 +503,15 @@ class FedAvgMuxClientManager:
             self._upload(node, msg, new_vars, variables, round_idx,
                          codec_name, slots[k],
                          float(num_samples[k]),
-                         {m: float(v[k]) for m, v in host_metrics.items()})
+                         {m: float(v[k]) for m, v in host_metrics.items()},
+                         delay_s=decisions.get(node, {}).get("delay_s",
+                                                             0.0))
             self.rounds_trained[node] += 1
         return True
 
     def _upload(self, node: int, msg: Message, new_vars, synced_vars,
                 round_idx, codec_name: str, slot: int, n_samples: float,
-                metrics: dict) -> None:
+                metrics: dict, delay_s: float = 0.0) -> None:
         from fedml_tpu.compress import wire_tree_digest
         from fedml_tpu.obs import comm_obs
 
@@ -477,6 +528,24 @@ class FedAvgMuxClientManager:
         reply.add_params(MSG_ARG_KEY_MODEL_PARAMS, wire)
         reply.add_params(MSG_ARG_KEY_NUM_SAMPLES, n_samples)
         reply.add_params(MSG_ARG_KEY_LOCAL_METRICS, metrics)
+        if delay_s and delay_s > 0.0:
+            # traffic-model arrival delay: the upload leaves on a timer
+            # thread so a slow device's lateness never stalls the rest
+            # of the vmapped cohort — the server sees an open-loop
+            # arrival trickle (and, past a close, a straggler the async
+            # cut discounts or the sync barrier stale-rejects)
+            tel = get_telemetry()
+            tel.inc("traffic.delayed_uploads")
+            tel.observe("traffic.upload_delay_s", float(delay_s))
+            t = threading.Timer(
+                float(delay_s), self._send_upload, args=(node, reply)
+            )
+            t.daemon = True
+            t.start()
+            return
+        self._send_upload(node, reply)
+
+    def _send_upload(self, node: int, reply: Message) -> None:
         # through the per-virtual (possibly chaos-wrapped) backend:
         # per-virtual-node send fault decisions + trace origin
         try:

@@ -1,0 +1,70 @@
+"""Staleness-weight math for the async buffered server — np|jnp
+polymorphic, one copy for every execution mode.
+
+The synchronous barrier's stale firewall (``FedAvgServerManager
+._is_stale``) REJECTS any upload whose echoed round is not the current
+one.  FedBuff-style async aggregation keeps honest late work instead:
+an upload computed against base round ``b`` folding into round ``r``
+is discounted by ``w(r - b)`` — down-weighted, not discarded — with
+the reject firewall retained as the hard outer bound
+(``--max-staleness``).
+
+Like ``core/robust.py``, every function here is a pure formula over
+whichever array namespace the caller passes (``xp=np`` on the server's
+host fold path, ``xp=jnp`` inside a jitted transform), so the server
+and any compiled twin compute the SAME weight from the same delta and
+tests can pin the two against one numpy oracle.
+
+Exactness contract: ``w(0) == 1.0`` for every policy, and the
+``w == 1.0`` fast path multiplies by a float64 ``1.0`` — fp-exact —
+which is what lets the async-vs-sync byte-identity pin hold when all
+arrivals are current (the equivalence anchor every mode change ships).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# staleness-weight policies the server accepts (--stale-policy):
+# - poly: w(d) = (1 + d)^-alpha — the FedBuff/FedAsync polynomial
+#   family; alpha=0 degenerates to w≡1 (the byte-identity arm)
+# - const: w(d) = 1 inside the window, 0 beyond it — a hard
+#   constant-window cut that still FOLDS in-window stragglers at full
+#   weight (the reject firewall handled out-of-window ones upstream)
+STALENESS_POLICIES = ("poly", "const")
+
+
+def staleness_weight(delta, policy: str = "poly", *, alpha: float = 0.5,
+                     window: int = 0, xp=jnp):
+    """Discount weight for an upload ``delta`` rounds stale.
+
+    ``delta`` may be a scalar or an array of round gaps (``r - b``);
+    negative deltas (an upload from the future — unreachable past the
+    reject firewall) clamp to 0.  Returns values in [0, 1] with
+    ``w(0) == 1.0`` exactly.
+    """
+    if policy not in STALENESS_POLICIES:
+        raise ValueError(
+            f"unknown staleness policy {policy!r} "
+            f"(one of {STALENESS_POLICIES})"
+        )
+    d = xp.maximum(xp.asarray(delta, xp.float64), 0.0)
+    if policy == "const":
+        return xp.where(d <= float(window), 1.0, 0.0)
+    if alpha < 0:
+        raise ValueError(f"poly staleness alpha must be >= 0: {alpha!r}")
+    # (1 + d)^-alpha; alpha == 0 gives exactly 1.0 for every delta
+    # (x**0 == 1.0 in IEEE 754), so the w≡1 anchor needs no branch
+    return (1.0 + d) ** (-float(alpha))
+
+
+def effective_weight(n, delta, policy: str = "poly", *, alpha: float = 0.5,
+                     window: int = 0, xp=jnp):
+    """The fold weight the streaming accumulator uses: ``w(delta) * n``.
+
+    Exactness: at ``delta == 0`` (or ``w == 1.0``) the product is
+    ``1.0 * n`` — fp-exact, so a run whose arrivals are all current
+    folds the IDENTICAL float64 weights the synchronous barrier folds.
+    """
+    w = staleness_weight(delta, policy, alpha=alpha, window=window, xp=xp)
+    return w * xp.asarray(n, xp.float64)

@@ -122,6 +122,19 @@ def _chaos_plan():
     return FaultPlan.from_env()
 
 
+def _traffic_model(role: str):
+    """Open-loop traffic model for this worker, or None: ``launch()``
+    ships ``TrafficModel`` JSON through ``FEDML_TPU_TRAFFIC`` (same
+    shape as the chaos plan's env ride), and the model's ``roles``
+    field gates which worker kinds draw from it."""
+    from fedml_tpu.faults.traffic import TrafficModel
+
+    tm = TrafficModel.from_env()
+    if tm is None or role not in tm.roles or not tm.any_traffic():
+        return None
+    return tm
+
+
 def _maybe_chaos(backend, role: str, plan=None):
     """Wrap the transport in a ``ChaosBackend`` when a fault plan rides
     the ``FEDML_TPU_CHAOS`` env var and names this role — how
@@ -392,6 +405,15 @@ def run_server(args) -> None:
         bcast=args.bcast,
         bcast_codec=args.bcast_codec,
         delta_base_window=args.delta_base_window,
+        # async buffered rounds (--round-mode async): fold-on-arrival,
+        # cut every --cut-size arrivals (or the round deadline), stale
+        # uploads in the --max-staleness window folded at the
+        # --stale-policy/--stale-alpha discount instead of rejected
+        round_mode=args.round_mode,
+        cut_size=args.cut_size,
+        max_staleness=args.max_staleness,
+        stale_policy=args.stale_policy,
+        stale_alpha=args.stale_alpha,
     )
     # startup barrier: the hub drops frames to unregistered receivers,
     # so broadcasting before every client registered would hang
@@ -490,6 +512,7 @@ def run_client(args) -> None:
         crash_at_round=_resolve_crash_round(
             args.crash_at_round, plan, args.node_id
         ),
+        traffic=_traffic_model("client"),
     )
     # the client's registry used to die here with nothing but a stdout
     # counter dump — now the whole thing (trace_hop chains, clock_sync,
@@ -561,6 +584,7 @@ def run_muxer(args) -> None:
         crash_at_round=min(crash_rounds) if crash_rounds else None,
         wrap_backend=wrap,
         rejoin_every_round=args.rejoin_every_round,
+        traffic=_traffic_model("muxer"),
     )
     mlog = _node_metrics_logger(args.run_dir, f"mux{args.node_id}")
     _install_flight(args.run_dir, f"mux{args.node_id}")
@@ -705,6 +729,12 @@ def launch(
     bcast: str = "full",
     bcast_codec: str = "",
     delta_base_window: int = 4,
+    round_mode: str = "sync",
+    cut_size: int = 0,
+    max_staleness: int = 2,
+    stale_policy: str = "poly",
+    stale_alpha: float = 0.5,
+    traffic_plan: str = "",
     mux_rejoin_every_round: bool = False,
     hotpath: str = "fast",
     fanout: str = "striped",
@@ -792,6 +822,12 @@ def launch(
         env["FEDML_TPU_CHAOS"] = chaos_plan
         if server_env is not None:
             server_env["FEDML_TPU_CHAOS"] = chaos_plan
+    if traffic_plan:
+        # open-loop traffic rides the env exactly like the chaos plan:
+        # workers parse TrafficModel JSON before their jax imports
+        env["FEDML_TPU_TRAFFIC"] = traffic_plan
+        if server_env is not None:
+            server_env["FEDML_TPU_TRAFFIC"] = traffic_plan
     if trace:
         # distributed tracing rides the env: every process (hub,
         # server, clients) stamps hops and shares one run id so the
@@ -844,6 +880,16 @@ def launch(
             common += ["--bcast-codec", bcast_codec]
         if delta_base_window != 4:
             common += ["--delta-base-window", str(delta_base_window)]
+        if round_mode != "sync":
+            common += ["--round-mode", round_mode]
+        if cut_size:
+            common += ["--cut-size", str(cut_size)]
+        if max_staleness != 2:
+            common += ["--max-staleness", str(max_staleness)]
+        if stale_policy != "poly":
+            common += ["--stale-policy", stale_policy]
+        if stale_alpha != 0.5:
+            common += ["--stale-alpha", str(stale_alpha)]
         if hotpath != "fast":
             common += ["--hotpath", hotpath]
         if decode_workers != 2:
@@ -1158,6 +1204,20 @@ def main(argv=None):
     # churn-soak knob (muxer role): drop + re-hello the hub connection
     # and forget delta bases after every trained round
     p.add_argument("--rejoin-every-round", action="store_true")
+    # async buffered rounds (server role): fold-on-arrival with cuts
+    # every --cut-size arrivals (0 = clients_per_round) instead of the
+    # synchronous barrier; in-window stale uploads (base round within
+    # --max-staleness of current) fold at the --stale-policy discount
+    # w(r-b) — poly: (1+d)^-alpha, const: 1 inside the window — while
+    # out-of-window ones still hit the reject firewall.  --stale-alpha 0
+    # is the byte-identity arm (w == 1 exactly).
+    p.add_argument("--round-mode", choices=["sync", "async"],
+                   default="sync")
+    p.add_argument("--cut-size", type=int, default=0)
+    p.add_argument("--max-staleness", type=int, default=2)
+    p.add_argument("--stale-policy", choices=["poly", "const"],
+                   default="poly")
+    p.add_argument("--stale-alpha", type=float, default=0.5)
     # wire hot-path knobs: --hotpath legacy reverts the server to
     # per-node unicast broadcast + buffered close-time aggregation (the
     # pre-multicast behavior — the latency measurement's baseline arm

@@ -67,6 +67,14 @@ COUNTERS = {
     "robust.capped_conns": "connections rescaled by the contribution cap",
     "robust.cap_infeasible": "rounds where the conn cap was unsatisfiable (left unapplied, loudly)",
     "rounds.degraded": "rounds closed under the aggregation target",
+    "async.cut_rounds": "async-mode round cuts (K arrivals or the cut deadline)",
+    "async.stale_weighted_uploads": "in-window stale uploads folded at discounted weight",
+    "async.discarded_weight": "sample weight removed by staleness discounts (sum (1-w)·n)",
+    "async.folded_weight": "sample weight folded into async cuts (sum w·n)",
+    "traffic.offline_rounds": "node-rounds skipped by traffic-model churn draws",
+    "traffic.delayed_uploads": "uploads deferred by a traffic-model delay draw",
+    "traffic.rebinds": "connection flaps (drop+redial) drawn by the traffic model",
+    "traffic.straggler_draws": "heavy-tailed straggler delays drawn by the traffic model",
     "flight.dumps": "flight-recorder bundles written {trigger=}",
     "flight.dumps_suppressed": "dumps skipped by the per-trigger rate limit or a dump already in flight {trigger=}",
     "flight.dump_errors": "bundle writes that failed (fs errors; recording continues)",
@@ -112,6 +120,8 @@ HISTOGRAMS = {
     "span.traced_round_s": "per-round synced seconds under trace_rounds",
     "slo.round_wall_s": "server round wall (open->close) — the SLO percentile source",
     "slo.round_bytes": "server-visible comm bytes folded per round (sent+recv delta)",
+    "async.upload_staleness": "round gap r-b of each accepted async upload (0 = current)",
+    "traffic.upload_delay_s": "per-upload delay the traffic model imposed",
     "jax.compile_s": "wall time of compile-triggering calls {fn=}",
     "jax.backend_compile_s": "runtime-reported compile durations {event=}",
     "flight.dump_write_s": "atomic flight-bundle write (snapshot + json + replace)",

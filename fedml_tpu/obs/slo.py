@@ -82,6 +82,14 @@ class SloSpec:
     max_outlier_uploads: Optional[int] = None  # cumulative outlier rejects <=
     max_degraded_rounds: Optional[int] = None  # cumulative degraded rounds <=
     max_stale_streams: Optional[int] = None    # silent/missing reporters <=
+    # async buffered-round objectives (--round-mode async), evaluated at
+    # each round CUT like everything else: the p99 of accepted uploads'
+    # round gaps (how far behind the population is running), and the
+    # fraction of arrived sample weight the staleness discount removed
+    # (discarded / (discarded + folded) — a model-quality budget: high
+    # discard means the cut cadence outruns the devices)
+    p99_upload_staleness: Optional[float] = None   # async.upload_staleness p99 <=
+    max_discarded_weight_frac: Optional[float] = None  # discarded weight frac <=
     # staleness threshold for reporter streams; None = derive it from
     # the report interval at engine construction (the server resolves
     # max(10 s, 5 x interval) — a 30 s interval must not flag every
@@ -228,6 +236,12 @@ class SloEngine:
         check("degraded_rounds",
               self._counter_sum(rollup_digest, "rounds.degraded"),
               spec.max_degraded_rounds)
+        check("upload_staleness_p99",
+              hist_quantile(hists.get("async.upload_staleness"), 0.99),
+              spec.p99_upload_staleness)
+        check("discarded_weight_frac",
+              self._discarded_frac(rollup_digest),
+              spec.max_discarded_weight_frac)
         stale, missing = self.coverage(rollup_digest, sources,
                                        expected_nodes)
         # silent streams AND never-covered nodes both count, each —
@@ -260,6 +274,16 @@ class SloEngine:
                 reason=",".join(v["objective"] for v in found),
             )
         return found
+
+    def _discarded_frac(self, rollup_digest: dict) -> Optional[float]:
+        """Fraction of arrived async sample weight the staleness
+        discount removed: ``discarded / (discarded + folded)``.  None
+        (not 0) when nothing has folded yet, so the objective cannot
+        spuriously pass/fail before the first cut."""
+        discarded = self._counter_sum(rollup_digest, "async.discarded_weight")
+        folded = self._counter_sum(rollup_digest, "async.folded_weight")
+        total = discarded + folded
+        return discarded / total if total > 0 else None
 
     def coverage(self, rollup_digest: dict, sources: dict,
                  expected_nodes=None):
@@ -297,6 +321,7 @@ class SloEngine:
         hists = rollup_digest.get("hists") or {}
         wall = hists.get("slo.round_wall_s") or {}
         rbytes = hists.get("slo.round_bytes") or {}
+        staleness = hists.get("async.upload_staleness") or {}
         stale, missing = self.coverage(rollup_digest, sources,
                                        expected_nodes)
         with self._lock:
@@ -343,6 +368,13 @@ class SloEngine:
                     rollup_digest, "faults.observed{kind=outlier_upload"),
                 "degraded_rounds": self._counter_sum(
                     rollup_digest, "rounds.degraded"),
+                "upload_staleness": {
+                    "p99": hist_quantile(staleness, 0.99),
+                    "count": staleness.get("count", 0),
+                    "max": staleness.get("max"),
+                },
+                "discarded_weight_frac": self._discarded_frac(
+                    rollup_digest),
             },
             "stats_plane": {
                 "streams": len(sources or {}),

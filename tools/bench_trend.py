@@ -39,7 +39,7 @@ PREFIXES = (
     "BENCH_", "FEDLAT_", "FEDSCALE_", "FEDTRACE_", "FEDHEALTH_",
     "FAULTS_", "CONVERGENCE_", "COMPRESS_", "MULTICHIP_", "SCALING_",
     "FEDERATION_", "ROBUST_", "FEDXPORT_", "FEDCHURN_", "FEDFLIGHT_",
-    "FEDTREE_",
+    "FEDTREE_", "FEDBUFF_", "FEDTRAFFIC_",
 )
 
 _ROUND_RE = re.compile(r"[_-]r(\d+)")
@@ -215,6 +215,35 @@ def _extract(doc: dict, fname: str) -> dict:
         ok = _deep_get(doc, "verdict.ok")
         if ok is not None:
             out["ok"] = bool(ok)
+    elif fname.startswith("FEDBUFF_"):
+        for arm in ("sync", "async"):
+            v = _num(_first(doc, f"openloop.{arm}.p99_round_s",
+                            f"openloop.{arm}.round_wall_s.p99"))
+            if v is not None:
+                out[f"p99[{arm}]"] = v
+        v = _num(_deep_get(doc, "openloop.p99_factor_sync_over_async"))
+        if v is not None:
+            out["p99_factor"] = v
+        v = _num(_deep_get(doc, "openloop.acc_margin"))
+        if v is not None:
+            out["acc_margin"] = v
+        for k in ("digest_pin", "determinism", "openloop"):
+            ok = _deep_get(doc, f"{k}.ok")
+            if ok is not None:
+                out[f"ok[{k}]"] = bool(ok)
+        if doc.get("ok") is not None:
+            out["ok"] = bool(doc["ok"])
+    elif fname.startswith("FEDTRAFFIC_"):
+        for k in ("offline_rounds", "delayed_uploads", "rebinds",
+                  "straggler_draws"):
+            v = _num(_deep_get(doc, f"traffic.{k}"))
+            if v is not None:
+                out[k] = v
+        ok = _deep_get(doc, "traffic.replay_ok")
+        if ok is None:
+            ok = doc.get("ok")
+        if ok is not None:
+            out["ok"] = bool(ok)
     elif fname.startswith("FAULTS_"):
         scenarios = doc.get("scenarios")
         if isinstance(scenarios, list):
@@ -291,6 +320,9 @@ GATE_RULES = {
     "COMPRESS_": ({"reduction_ratio": "lower"}, 0.10),
     "FEDFLIGHT_": ({"overhead_ratio": "lower",
                     "attributed": "higher", "ok": "true"}, 0.10),
+    "FEDBUFF_": ({"p99_factor": "higher", "acc_margin": "higher",
+                  "ok": "true", "ok[*": "true"}, 0.15),
+    "FEDTRAFFIC_": ({"ok": "true"}, 0.0),
 }
 
 

@@ -60,6 +60,21 @@ round with a finite global model.  Default matrix:
                          event; the root's deadline closes the round on
                          the surviving edge's partials, degradation
                          visible, NaN-free to the final round
+    flapping_client      open-loop traffic engine: the muxed cohort's
+                         connection flaps (drop + re-hello mid-run, PR
+                         13's rebind primitive) and nodes churn
+                         offline per round — rounds degrade by
+                         deadline, never wedge
+    overload_burst       traffic engine at the diurnal peak: arrival
+                         delays + heavy-tailed straggler draws spike
+                         together mid-run; the deadline (sync) or cut
+                         (async) absorbs the burst NaN-free
+    compound_crash_telemetry
+                         TWO simultaneous faults: a sampled client
+                         crashes at round 1 WHILE another node's digest
+                         stream is blacked out — the forensics verdict
+                         SET must attribute both (client_crash AND
+                         telemetry_loss), not just the dominant one
 
     ``--lane shm`` / ``--bcast delta`` re-run the WHOLE matrix over the
     new transport path (FEDXPORT acceptance: all prior scenarios
@@ -107,6 +122,7 @@ def _scenarios(round_timeout: float, num_clients: int = 3):
     deadline: without one a single lost upload wedges the federation
     forever (the exact failure mode this subsystem exists to kill)."""
     from fedml_tpu.faults import FaultPlan, FaultRule, FaultSpec
+    from fedml_tpu.faults.traffic import TrafficModel
 
     drop_plan = FaultPlan(
         seed=0,
@@ -170,6 +186,22 @@ def _scenarios(round_timeout: float, num_clients: int = 3):
                          msg_type="C2S_SEND_MODEL", direction="send")
                for n in range(1, muxed_half + 1)],
         roles=("client",),
+    ).to_json()
+    # open-loop traffic arms (faults/traffic.py): seeded arrival
+    # processes shipped via FEDML_TPU_TRAFFIC — a deterministic day of
+    # churn, not a flake.  Probabilities are per (node x round).
+    flapping_traffic = TrafficModel(
+        seed=0, jitter_s=0.1, churn_prob=0.25, flap_prob=0.5,
+    ).to_json()
+    # diurnal peak: amplitude 1 on a 2-round period puts every other
+    # round at ~2x load — delays and heavy-tailed straggler draws spike
+    # together; the straggler cap stays well under the round deadline
+    # so most late uploads still arrive (and in async mode fold at the
+    # staleness discount) instead of all vanishing at once
+    burst_traffic = TrafficModel(
+        seed=0, jitter_s=0.1, straggler_prob=0.6,
+        straggler_scale_s=0.3, straggler_cap_s=2.0,
+        diurnal_amplitude=1.0, diurnal_period_rounds=2,
     ).to_json()
     return {
         "fault_free": {},
@@ -299,6 +331,37 @@ def _scenarios(round_timeout: float, num_clients: int = 3):
             "crash_edge_hub_at_round": 1,
             "round_timeout": round_timeout,
         },
+        # churn mid-round via the traffic engine: the muxed half-cohort
+        # flaps its ONE connection (drop + re-hello between rounds —
+        # PR 13's rebind_connection) while nodes churn offline per
+        # round; the reconnect machinery absorbs the flaps and the
+        # deadline closes churned rounds degraded, never wedged
+        "flapping_client": {
+            "muxers": 1,
+            "muxed_clients": -1,  # resolved to ceil(N/2) in run_scenario
+            "traffic_plan": flapping_traffic,
+            "auto_reconnect": 60,
+            "round_timeout": round_timeout,
+        },
+        # arrival spike at the diurnal peak: every node's delay +
+        # straggler draw inflates together on peak rounds — the
+        # deadline (sync) or the cut + staleness discount (async) must
+        # absorb the burst with finite aggregates
+        "overload_burst": {
+            "traffic_plan": burst_traffic,
+            "round_timeout": round_timeout,
+        },
+        # TWO simultaneous faults: the last sampled client hard-exits
+        # at round 1 WHILE node 2's digest stream is blacked out.  The
+        # forensics verdict SET must attribute both (client_crash AND
+        # telemetry_loss) — the compound-attribution contract
+        "compound_crash_telemetry": {
+            "crash_client_at_round": 1,
+            "chaos_plan": telemetry_plan,
+            "round_timeout": round_timeout,
+            "slo": json.dumps({"max_stale_streams": 0,
+                               "stale_after_s": 1.5}),
+        },
     }
 
 
@@ -353,6 +416,14 @@ def _forensics(run_dir: str) -> dict:
         "confidence": v.get("confidence"),
         "clock_mode": v.get("clock_mode"),
         "evidence": v.get("evidence"),
+        # the RANKED verdict set (compound faults get one entry each);
+        # the top-level fields above are its dominant entry
+        "verdicts": [
+            {"fault_kind": c.get("fault_kind"),
+             "fault_round": c.get("fault_round"),
+             "confidence": c.get("confidence")}
+            for c in (v.get("verdicts") or ())
+        ],
         "bundle_errors": v.get("bundle_errors"),
     }
 
@@ -466,6 +537,13 @@ def main(argv=None) -> int:
     # aggregation tree (PR 17) — scenario-pinned keys still win
     p.add_argument("--topology", choices=["flat", "tree"], default="flat")
     p.add_argument("--edge-hubs", type=int, default=2)
+    # round-mode override: soak the whole matrix over the async
+    # buffered server (fold-on-arrival, cut-based rounds, staleness
+    # discounts) — every fault mode that held under the barrier must
+    # hold under cuts
+    p.add_argument("--round-mode", choices=["sync", "async"],
+                   default="sync")
+    p.add_argument("--max-staleness", type=int, default=2)
     args = p.parse_args(argv)
 
     scenarios = _scenarios(args.round_timeout, args.num_clients)
@@ -485,6 +563,9 @@ def main(argv=None) -> int:
     if args.topology == "tree":
         transport["topology"] = "tree"
         transport["edge_hubs"] = args.edge_hubs
+    if args.round_mode != "sync":
+        transport["round_mode"] = args.round_mode
+        transport["max_staleness"] = args.max_staleness
 
     results = []
     for name, kwargs in scenarios.items():
@@ -507,6 +588,7 @@ def main(argv=None) -> int:
         "matrix": args.matrix if not args.scenario else args.scenario,
         "lane": args.lane,
         "bcast": args.bcast,
+        "round_mode": args.round_mode,
         "num_clients": args.num_clients,
         "rounds": args.rounds,
         "seed": args.seed,

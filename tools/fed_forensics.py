@@ -301,23 +301,49 @@ def _inj_round(slot: dict, intervals: List[dict]) -> Optional[int]:
     return locate_round(slot.get("first_t"), intervals)
 
 
+_CONF_RANK = {"high": 0, "medium": 1, "low": 2}
+
+
 def attribute(bundles: Dict[str, dict], clock: Clock,
               intervals: List[dict], ev: dict) -> dict:
-    """Ordered decision tree -> {fault_kind, fault_round, confidence,
-    evidence: [...]}.  Explicit beats injected beats inferred."""
+    """Evidence channels -> RANKED verdict set.
+
+    Each independent evidence channel — crash dumps per process, each
+    chaos injection family, inferred signatures (reconnect storms, shm
+    saturation, deadline overruns), tolerance observations — contributes
+    its own candidate ``{fault_kind, fault_round, confidence,
+    evidence}``, so SIMULTANEOUS faults (a muxer crash DURING a
+    telemetry-drop plan; a straggler riding an overload burst) each get
+    a verdict instead of the highest-priority one shadowing the rest.
+    The full ranked list rides ``verdicts`` (explicit beats injected
+    beats inferred, stable within a confidence tier); the dominant
+    verdict's fields stay top-level for single-fault consumers
+    (``chaos_run``'s per-scenario record).  Channels that merely
+    RESTATE a higher channel's root cause (overrun-inferred straggler
+    when a ``delay`` plan injected one; reject-inferred corruption when
+    a ``corrupt`` plan is on record) stay suppressed — the set is of
+    distinct faults, not of evidence echoes."""
 
     def verdict(kind, rnd, conf, evidence):
         return {"fault_kind": kind, "fault_round": rnd,
                 "confidence": conf, "evidence": evidence}
 
-    # 1. a process dumped a crash bundle on its way down
-    if ev["crashes"]:
-        c = _first(ev["crashes"])
-        tag = c["tag"]
+    cands: List[dict] = []
+
+    # 1. processes that dumped crash bundles on the way down — one
+    # verdict PER crashed process (two workers dying in one run are
+    # two faults, not one)
+    crashes_by_tag: Dict[str, List[dict]] = defaultdict(list)
+    for c in ev["crashes"]:
+        crashes_by_tag[c["tag"]].append(c)
+    for tag in sorted(crashes_by_tag):
+        c = _first(crashes_by_tag[tag])
         if tag.startswith("mux"):
             shm = ev["shm_frames"].get(tag, 0.0) or any(
                 r["tag"] == tag for r in ev["shm_refusals"])
             kind = "shm_peer_crash" if shm else "muxer_crash"
+        elif tag.startswith("edge"):
+            kind = "edge_hub_crash"
         elif tag.startswith("node") and tag != "node0":
             kind = "client_crash"
         else:
@@ -325,12 +351,15 @@ def attribute(bundles: Dict[str, dict], clock: Clock,
         rnd = c.get("round")
         if rnd is None:
             rnd = locate_round(c.get("t"), intervals)
-        return verdict(kind, rnd, "high", [
+        cands.append(verdict(kind, rnd, "high", [
             {"source": tag, "kind": "crash_trigger",
-             "reason": c.get("reason"), "round": c.get("round")}])
+             "reason": c.get("reason"), "round": c.get("round")}]))
 
-    # 2. chaos-layer injections recorded by the injecting process
+    # 2. chaos-layer injections recorded by the injecting process —
+    # one verdict per injected FAMILY, all of them (a plan that both
+    # delays and drops is two concurrent faults)
     inj = ev["injections"]
+    claimed: set = set()
     if inj:
         def ivd(action):
             slot = inj[action]
@@ -341,11 +370,13 @@ def attribute(bundles: Dict[str, dict], clock: Clock,
 
         stripe = [a for a in STRIPE_ACTIONS if a in inj]
         if stripe:
+            claimed.update(stripe)
             rnd = _inj_round(inj[stripe[0]], intervals)
-            return verdict("stripe_fault", rnd, "high",
-                           [ivd(a) for a in stripe])
+            cands.append(verdict("stripe_fault", rnd, "high",
+                                 [ivd(a) for a in stripe]))
         byz = [a for a in BYZANTINE_ACTIONS if a in inj]
         if byz:
+            claimed.update(byz)
             a = byz[0]
             from_mux = any(t.startswith("mux") for t in inj[a]["tags"])
             kind = "malicious_muxer" if from_mux else "malicious_client"
@@ -354,43 +385,54 @@ def attribute(bundles: Dict[str, dict], clock: Clock,
                 extra.append({"source": "server", "kind": "counter",
                               "name": "robust.capped_conns",
                               "count": ev["capped_conns"]})
-            return verdict(kind, _inj_round(inj[a], intervals), "high",
-                           [ivd(x) for x in byz] + extra)
+            cands.append(verdict(kind, _inj_round(inj[a], intervals),
+                                 "high", [ivd(x) for x in byz] + extra))
         if "corrupt" in inj:
+            claimed.add("corrupt")
             rnd = _inj_round(inj["corrupt"], intervals)
             if rnd is None:
                 served = [r for r in ev["rejects"]
                           if r.get("round") is not None]
                 rnd = min(r["round"] for r in served) if served else None
-            return verdict("corrupt_upload", rnd, "high",
-                           [ivd("corrupt")])
+            cands.append(verdict("corrupt_upload", rnd, "high",
+                                 [ivd("corrupt")]))
         if "delay" in inj:
-            return verdict("straggler", _inj_round(inj["delay"], intervals),
-                           "high", [ivd("delay")])
+            claimed.add("delay")
+            cands.append(verdict(
+                "straggler", _inj_round(inj["delay"], intervals),
+                "high", [ivd("delay")]))
         if "drop" in inj:
+            claimed.add("drop")
             slot = inj["drop"]
             if slot["msg_types"] and slot["msg_types"] <= set(
                     TELEMETRY_MSG_TYPES):
                 rnd = _inj_round(slot, intervals)
                 if rnd is None and ev["slo_violations"]:
                     rnd = _first(ev["slo_violations"]).get("round")
-                return verdict("telemetry_loss", rnd, "high", [ivd("drop")])
-            return verdict("message_drop", _inj_round(slot, intervals),
-                           "high", [ivd("drop")])
-        any_a = sorted(inj)[0]
-        return verdict(f"chaos:{any_a}", _inj_round(inj[any_a], intervals),
-                       "medium", [ivd(any_a)])
+                cands.append(verdict("telemetry_loss", rnd, "high",
+                                     [ivd("drop")]))
+            else:
+                cands.append(verdict(
+                    "message_drop", _inj_round(slot, intervals),
+                    "high", [ivd("drop")]))
+        for a in sorted(set(inj) - claimed):
+            cands.append(verdict(
+                f"chaos:{a}", _inj_round(inj[a], intervals),
+                "medium", [ivd(a)]))
 
-    # 3. hub restart: dialers saw their hub connection die AND come back
-    if ev["reconnects"] and ev["conn_deaths"]:
+    # 3. hub restart: dialers saw their hub connection die AND come
+    # back — suppressed when a crash verdict already explains the
+    # conn deaths (a dead worker's peers see its connection die too)
+    if ev["reconnects"] and ev["conn_deaths"] and not crashes_by_tag:
         deaths = [d for d in ev["conn_deaths"] if d["tag"] != "hub"]
         d = _first(deaths or ev["conn_deaths"])
-        return verdict("hub_restart", locate_round(d.get("t"), intervals),
-                       "medium", [
-            {"source": d["tag"], "kind": "conn_death",
-             "reason": d.get("reason")},
-            {"source": "dialers", "kind": "counter",
-             "name": "comm.reconnects", "count": ev["reconnects"]}])
+        cands.append(verdict(
+            "hub_restart", locate_round(d.get("t"), intervals),
+            "medium", [
+                {"source": d["tag"], "kind": "conn_death",
+                 "reason": d.get("reason")},
+                {"source": "dialers", "kind": "counter",
+                 "name": "comm.reconnects", "count": ev["reconnects"]}]))
 
     # 4. shm ring saturation: every payload took the counted fallback
     ring_full = ev["shm_fallbacks"].get("ring_full", 0.0) + \
@@ -400,50 +442,61 @@ def attribute(bundles: Dict[str, dict], clock: Clock,
                     if r.get("reason") in ("ring_full", "desc_full")]
         rnd = locate_round(_first(refusals)["t"], intervals) \
             if refusals else (intervals[0]["round"] if intervals else None)
-        return verdict("shm_ring_full", rnd, "medium", [
+        cands.append(verdict("shm_ring_full", rnd, "medium", [
             {"source": "senders", "kind": "counter",
              "name": "comm.shm_fallbacks",
-             "by_reason": dict(ev["shm_fallbacks"])}])
+             "by_reason": dict(ev["shm_fallbacks"])}]))
 
-    # 5. repeated deadline overruns with nothing injected: a straggler
+    # 5. repeated deadline overruns with no DELAY injected: a
+    # straggler the plans didn't schedule (open-loop traffic, a slow
+    # device) — an injected delay already claimed this signature
     overruns = [o for o in ev["deadline_overruns"]
                 if o.get("round") is not None]
-    if overruns:
+    if overruns and "delay" not in inj:
         rounds = sorted({o["round"] for o in overruns})
         conf = "medium" if len(rounds) >= 2 else "low"
-        return verdict("straggler", rounds[0], conf, [
+        cands.append(verdict("straggler", rounds[0], conf, [
             {"source": sorted({o["tag"] for o in overruns}),
-             "kind": "deadline_overrun", "rounds": rounds}])
+             "kind": "deadline_overrun", "rounds": rounds}]))
 
     # 6. server-side tolerance observations without injector bundles
-    if ev["rejects"]:
+    # (with injections on record the rejects are their echo, not a
+    # second fault)
+    if ev["rejects"] and not inj:
         whats = {r.get("what") for r in ev["rejects"]} - {None}
         served = [r for r in ev["rejects"] if r.get("round") is not None]
         rnd = min(r["round"] for r in served) if served else \
             locate_round(_first(ev["rejects"]).get("t"), intervals)
         kind = "malicious_client" if "outlier_upload" in whats \
             else "corrupt_upload"
-        return verdict(kind, rnd, "low", [
+        cands.append(verdict(kind, rnd, "low", [
             {"source": "server", "kind": "rejects",
-             "what": sorted(whats), "count": len(ev["rejects"])}])
+             "what": sorted(whats), "count": len(ev["rejects"])}]))
 
-    # 7. stats-plane SLO violations with healthy rounds
-    if ev["slo_violations"]:
+    # 7. weakest channels: only when nothing stronger found anything
+    if not cands and ev["slo_violations"]:
         v = _first(ev["slo_violations"])
-        return verdict("telemetry_loss", v.get("round"), "low", [
+        cands.append(verdict("telemetry_loss", v.get("round"), "low", [
             {"source": v["tag"], "kind": "slo_violation",
-             "reason": v.get("reason")}])
-
-    if ev["exceptions"]:
+             "reason": v.get("reason")}]))
+    if not cands and ev["exceptions"]:
         e = _first(ev["exceptions"])
-        return verdict("exception", locate_round(e.get("t"), intervals),
-                       "low", [{"source": e["tag"], "kind": "exception",
-                                "reason": e.get("reason")}])
+        cands.append(verdict(
+            "exception", locate_round(e.get("t"), intervals),
+            "low", [{"source": e["tag"], "kind": "exception",
+                     "reason": e.get("reason")}]))
 
-    return verdict("none", None, "high",
-                   [{"kind": "no_anomaly",
-                     "detail": "no trigger, injection, or tolerance "
-                               "observation in any bundle"}])
+    if not cands:
+        cands.append(verdict(
+            "none", None, "high",
+            [{"kind": "no_anomaly",
+              "detail": "no trigger, injection, or tolerance "
+                        "observation in any bundle"}]))
+
+    # rank: confidence tier first, channel priority (generation order)
+    # within a tier — python's sort is stable
+    cands.sort(key=lambda v: _CONF_RANK.get(v["confidence"], 3))
+    return {**cands[0], "verdicts": cands}
 
 
 # -- round diff -------------------------------------------------------------
@@ -650,7 +703,9 @@ def analyze(run_dir: str) -> dict:
     intervals = round_intervals(bundles, clock)
     ev = collect_evidence(bundles, clock)
     v = attribute(bundles, clock, intervals, ev)
-    anomalous = {v["fault_round"]} if v["fault_round"] is not None else set()
+    # every ranked verdict's round is implicated, not just the top one
+    anomalous = {c["fault_round"] for c in v.get("verdicts", [v])
+                 if c.get("fault_round") is not None}
     for o in ev["deadline_overruns"]:
         if o.get("round") is not None:
             anomalous.add(o["round"])

@@ -104,7 +104,8 @@ def render_status(status: dict, report=None) -> str:
     interesting = {k: v for k, v in sorted(counters.items())
                    if k.startswith(("rounds.", "faults.observed",
                                     "comm.reconnects", "digest.",
-                                    "robust.", "slo.violations"))}
+                                    "robust.", "slo.violations",
+                                    "async.", "traffic."))}
     if interesting:
         lines.append("rollup counters (merged across the federation):")
         for k, v in list(interesting.items())[:20]:
@@ -121,6 +122,13 @@ def render_status(status: dict, report=None) -> str:
             f"  bytes/round p50 {rb.get('p50')}  "
             f"participation min {(obs.get('participation') or {}).get('min')}"
         )
+        st = obs.get("upload_staleness") or {}
+        if st.get("count"):
+            lines.append(
+                f"  async staleness p99 {st.get('p99')} rounds "
+                f"(n={st.get('count')})  discarded weight frac "
+                f"{obs.get('discarded_weight_frac')}"
+            )
     return "\n".join(lines)
 
 

@@ -1,0 +1,332 @@
+#!/usr/bin/env python
+"""FEDBUFF evidence campaign: async buffered rounds vs the synchronous
+barrier under open-loop production traffic.
+
+The async server (``--round-mode async``) exists for exactly one
+claim: when arrivals are an open-loop process — heavy-tailed straggler
+delays, churn, diurnal load — cutting a round at K arrivals and
+folding honest-but-late work at a staleness discount degrades
+GRACEFULLY, where the barrier pays the full deadline every time one
+device is slow or gone.  This campaign measures that claim as a
+controlled experiment and writes the machine-readable verdict
+(``FEDBUFF_r18.json``) that ``tools/bench_trend.py`` trends and gates.
+
+Stages (each independently ok-flagged):
+
+1. **determinism** — the traffic day replays bit-identically: the
+   seeded ``TrafficModel``'s full (node x round) decision trace hashes
+   to the same ``schedule_digest`` across a JSON ship-and-parse
+   round trip, and a reseeded model diverges.  Both arms of stage 3
+   therefore see the IDENTICAL arrival process — the A/B is
+   controlled, not anecdotal.
+2. **digest_pin** — the equivalence anchor: an in-process federation
+   run sync and then async with ``stale_alpha=0`` (w == 1) at the same
+   seed must produce BYTE-IDENTICAL final models (sha256 over the
+   leaves).  Cut-based rounds are a superset of the barrier, not a
+   different algorithm.
+3. **openloop** — the headline A/B: >= 32 virtual clients over muxer
+   processes, one seeded heavy-tailed straggler + churn + diurnal
+   traffic plan shipped to both arms, sync vs async at the same seed.
+   p99 round wall (sync, barrier/deadline-closed) vs p99 round-cut
+   latency (async), both from the server's ``round_log``
+   ``t_open_m/t_close_m`` stamps, plus final held-out accuracy per
+   arm.
+
+Pre-declared bars (``BARS`` below, declared before any measurement):
+the sync p99 must exceed the async p99 by at least
+``p99_factor_min``, and the async arm's final accuracy must not trail
+sync by more than ``-acc_margin_min``.
+
+Usage (CPU is fine — the contrast is protocol stalls, not FLOPs):
+
+    python tools/fed_traffic_run.py --out FEDBUFF_r18.json
+    python tools/fed_traffic_run.py --quick        # small smoke form
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import math
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+# pre-declared acceptance bars — set BEFORE the campaign runs, never
+# tuned to a measurement after the fact
+BARS = {
+    # sync p99 round wall / async p99 cut latency must be >= this
+    "p99_factor_min": 1.2,
+    # async final acc - sync final acc must be >= this (async may not
+    # trail the barrier by more than 5 points under the same traffic)
+    "acc_margin_min": -0.05,
+}
+
+
+def _worker_env():
+    import chaos_run
+
+    return chaos_run._worker_env()
+
+
+def percentile(vals, q: float):
+    """Nearest-rank percentile (the fed_timeline convention)."""
+    if not vals:
+        return None
+    s = sorted(vals)
+    return s[min(len(s) - 1, max(0, int(math.ceil(q * len(s))) - 1))]
+
+
+def campaign_traffic(seed: int):
+    """The campaign's one traffic day: heavy-tailed stragglers + churn
+    + a diurnal swing.  Caps stay well under the round deadline so the
+    tail hurts the BARRIER (it waits) rather than erasing uploads."""
+    from fedml_tpu.faults.traffic import TrafficModel
+
+    return TrafficModel(
+        seed=seed,
+        jitter_s=0.05,
+        straggler_prob=0.3,
+        straggler_shape=1.1,       # heavy tail: infinite variance
+        straggler_scale_s=0.3,
+        straggler_cap_s=2.0,
+        churn_prob=0.08,
+        flap_prob=0.02,
+        diurnal_amplitude=0.5,
+        diurnal_period_rounds=4,
+    )
+
+
+# -- stage 1: replay determinism ---------------------------------------------
+
+def stage_determinism(seed: int, clients: int, rounds: int) -> dict:
+    from fedml_tpu.faults.traffic import TrafficModel
+
+    tm = campaign_traffic(seed)
+    nodes = list(range(1, clients + 1))
+    d1 = tm.schedule_digest(nodes, rounds)
+    # the digest must survive the exact path the plan takes to worker
+    # subprocesses: JSON out, env ride, JSON in
+    d2 = TrafficModel.from_json(tm.to_json()).schedule_digest(nodes, rounds)
+    d_other = TrafficModel.from_json(
+        campaign_traffic(seed + 1).to_json()).schedule_digest(nodes, rounds)
+    # deterministic trace statistics — the open-loop day in numbers
+    # (computed from the pure model, identical in every process)
+    offline = stragglers = delayed = rebinds = 0
+    for r in range(rounds):
+        for n in nodes:
+            d = tm.decide(n, r)
+            offline += d["offline"]
+            stragglers += d["straggler"]
+            rebinds += d["rebind"]
+            delayed += d["delay_s"] > 0
+    return {
+        "schedule_digest": d1,
+        "replay_digest": d2,
+        "reseeded_digest": d_other,
+        "replay_ok": d1 == d2,
+        "reseeded_differs": d1 != d_other,
+        "trace": {"node_rounds": clients * rounds, "offline": offline,
+                  "stragglers": stragglers, "delayed": delayed,
+                  "rebinds": rebinds},
+        "ok": d1 == d2 and d1 != d_other,
+    }
+
+
+# -- stage 2: async == sync byte-identity at w == 1 --------------------------
+
+def _model_digest(variables) -> str:
+    import numpy as np
+
+    import jax
+
+    h = hashlib.sha256()
+    for leaf in jax.tree_util.tree_leaves(variables):
+        h.update(np.asarray(leaf).tobytes())
+    return h.hexdigest()
+
+
+def stage_digest_pin(seed: int) -> dict:
+    """In-process 3-client federation, sync vs async(w==1), same seed:
+    final models must hash identically — the byte-identity anchor."""
+    import numpy as np
+
+    import jax
+
+    from fedml_tpu.algorithms.fedavg_cross_device import (
+        FedAvgClientManager, FedAvgServerManager)
+    from fedml_tpu.comm.inproc import InprocBus
+    from fedml_tpu.core.client import make_client_optimizer, make_local_update
+    from fedml_tpu.data.synthetic import synthetic_classification
+    from fedml_tpu.models.linear import logistic_regression
+
+    ds = synthetic_classification(
+        num_train=240, num_test=60, input_shape=(16,), num_classes=4,
+        num_clients=3, partition="hetero", partition_alpha=0.4, seed=seed)
+    bundle = logistic_regression(16, 4)
+    init = bundle.init(jax.random.PRNGKey(seed))
+    lu = make_local_update(bundle, make_client_optimizer("sgd", 0.1,
+                                                         momentum=0.9), 1)
+    steps = int(np.ceil(ds.client_sample_counts().max() / 16))
+
+    def run(**kw):
+        bus = InprocBus()
+        server = FedAvgServerManager(
+            bus.register(0), init, num_clients=3, clients_per_round=3,
+            comm_rounds=3, seed=seed, steps_per_epoch=steps, **kw)
+        for i in range(3):
+            FedAvgClientManager(bus.register(i + 1), lu, ds, batch_size=16,
+                                template_variables=init, seed=seed)
+        server.start()
+        bus.drain()
+        return _model_digest(server.variables)
+
+    d_sync = run()
+    d_async = run(round_mode="async", stale_alpha=0.0)
+    return {"sync_digest": d_sync, "async_digest": d_async,
+            "ok": d_sync == d_async}
+
+
+# -- stage 3: open-loop A/B --------------------------------------------------
+
+def _run_arm(name: str, *, clients: int, muxers: int, rounds: int,
+             seed: int, round_timeout: float, traffic_json: str,
+             timeout: float, extra: dict) -> dict:
+    import numpy as np
+
+    import chaos_run
+    from fedml_tpu.experiments.distributed_fedavg import launch
+
+    out_path = os.path.join(
+        tempfile.mkdtemp(prefix=f"fedbuff_{name}_"), "final.npz")
+    info: dict = {}
+    t0 = time.time()
+    print(f"== arm {name} ({clients} clients, {rounds} rounds) ==",
+          flush=True)
+    rc = launch(
+        num_clients=clients, rounds=rounds, seed=seed, batch_size=16,
+        out_path=out_path, muxers=muxers, round_timeout=round_timeout,
+        traffic_plan=traffic_json, auto_reconnect=60,
+        env=_worker_env(), info=info, timeout=timeout, **extra,
+    )
+    rec = {"arm": name, "rc": rc, "survived": rc == 0,
+           "wall_s": round(time.time() - t0, 1),
+           "rounds": info.get("rounds"),
+           "rounds_degraded": info.get("rounds_degraded"),
+           "rejected_uploads": info.get("rejected_uploads")}
+    if os.path.exists(out_path):
+        z = np.load(out_path)
+        round_log = json.loads(str(z["round_log"]))
+        walls = [r["t_close_m"] - r["t_open_m"] for r in round_log
+                 if "t_open_m" in r and "t_close_m" in r]
+        rec["round_wall_s"] = {
+            "p50": percentile(walls, 0.5),
+            "p99": percentile(walls, 0.99),
+            "max": max(walls) if walls else None,
+            "n": len(walls),
+        }
+        rec["p99_round_s"] = rec["round_wall_s"]["p99"]
+        try:
+            rec.update(chaos_run._final_model_eval(out_path, seed, clients))
+        except Exception as e:
+            rec["eval_error"] = f"{type(e).__name__}: {e}"
+            rec["nan_free"] = False
+    # server-side async/traffic counter evidence (the faults dict on
+    # the server's exit line carries faults.* only; async.* counters
+    # ride stats_plane rollup when on — keep what launch() collected)
+    rec["stats_plane"] = info.get("stats_plane") or {}
+    return rec
+
+
+def stage_openloop(*, clients: int, muxers: int, rounds: int, seed: int,
+                   round_timeout: float, cut_frac: float,
+                   timeout: float) -> dict:
+    traffic_json = campaign_traffic(seed).to_json()
+    sync = _run_arm("sync", clients=clients, muxers=muxers, rounds=rounds,
+                    seed=seed, round_timeout=round_timeout,
+                    traffic_json=traffic_json, timeout=timeout, extra={})
+    cut = max(1, int(clients * cut_frac))
+    asyn = _run_arm("async", clients=clients, muxers=muxers, rounds=rounds,
+                    seed=seed, round_timeout=round_timeout,
+                    traffic_json=traffic_json, timeout=timeout,
+                    extra={"round_mode": "async", "cut_size": cut})
+    out = {"clients": clients, "muxers": muxers, "rounds": rounds,
+           "cut_size": cut, "round_timeout_s": round_timeout,
+           "sync": sync, "async": asyn}
+    sp = (sync.get("round_wall_s") or {}).get("p99")
+    ap = (asyn.get("round_wall_s") or {}).get("p99")
+    factor = (sp / ap) if (sp and ap) else None
+    margin = (asyn["final_acc"] - sync["final_acc"]) \
+        if ("final_acc" in asyn and "final_acc" in sync) else None
+    out["p99_factor_sync_over_async"] = factor
+    out["acc_margin"] = margin
+    out["bars"] = dict(BARS)
+    out["ok"] = bool(
+        sync.get("survived") and asyn.get("survived")
+        and sync.get("nan_free") and asyn.get("nan_free")
+        and factor is not None and factor >= BARS["p99_factor_min"]
+        and margin is not None and margin >= BARS["acc_margin_min"]
+    )
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", default="FEDBUFF_r18.json")
+    p.add_argument("--clients", type=int, default=32)
+    p.add_argument("--muxers", type=int, default=2)
+    p.add_argument("--rounds", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--round-timeout", type=float, default=15.0,
+                   help="sync barrier deadline AND async cut deadline; "
+                        "must exceed cold jit+train on the host")
+    p.add_argument("--cut-frac", type=float, default=0.75,
+                   help="async cut target as a fraction of the cohort")
+    p.add_argument("--timeout", type=float, default=600.0)
+    p.add_argument("--quick", action="store_true",
+                   help="small smoke form (8 clients, 2 rounds)")
+    p.add_argument("--skip-openloop", action="store_true",
+                   help="stages 1-2 only (no subprocess federation)")
+    args = p.parse_args(argv)
+    if args.quick:
+        args.clients, args.muxers, args.rounds = 8, 1, 2
+        args.round_timeout = min(args.round_timeout, 12.0)
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    doc = {
+        "bars": dict(BARS),
+        "config": {"clients": args.clients, "muxers": args.muxers,
+                   "rounds": args.rounds, "seed": args.seed,
+                   "cut_frac": args.cut_frac,
+                   "round_timeout_s": args.round_timeout},
+        "generated_unix": round(time.time(), 1),
+    }
+    doc["determinism"] = stage_determinism(args.seed, args.clients,
+                                           args.rounds)
+    print(json.dumps({"determinism_ok": doc["determinism"]["ok"]}),
+          flush=True)
+    doc["digest_pin"] = stage_digest_pin(args.seed)
+    print(json.dumps({"digest_pin_ok": doc["digest_pin"]["ok"]}),
+          flush=True)
+    if not args.skip_openloop:
+        doc["openloop"] = stage_openloop(
+            clients=args.clients, muxers=args.muxers, rounds=args.rounds,
+            seed=args.seed, round_timeout=args.round_timeout,
+            cut_frac=args.cut_frac, timeout=args.timeout)
+    oks = [doc["determinism"]["ok"], doc["digest_pin"]["ok"]] + \
+        ([doc["openloop"]["ok"]] if "openloop" in doc else [])
+    doc["ok"] = all(oks)
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh, indent=1, default=float)
+    print(json.dumps({"out": args.out, "ok": doc["ok"],
+                      "stage_oks": oks}))
+    return 0 if doc["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

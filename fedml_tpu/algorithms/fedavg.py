@@ -91,6 +91,7 @@ def make_round_fn(
     client_unroll: int = 1,
     codec=None,
     error_feedback: bool = False,
+    aggregate_impl: Optional[Callable] = None,
 ):
     """Build the per-round function over a packed client block.
 
@@ -226,12 +227,21 @@ def make_round_fn(
                 state.variables, client_vars, weights, agg_rngs
             )
 
-        num = jax.tree_util.tree_map(
-            lambda leaf: jnp.einsum(
-                "k,k...->...", weights, leaf.astype(jnp.float32)
-            ),
-            client_vars,
-        )
+        if aggregate_impl is not None:
+            # pluggable weighted-sum kernel: the partition-rule engine
+            # (parallel/partition.py) substitutes a sequential lax.scan
+            # here — on a dp-sharded mesh the GSPMD partitioner may
+            # partial-sum the einsum's K axis per device, which
+            # reassociates the fp32 reduction and breaks the
+            # sharded-vs-replicated sha256 parity pins
+            num = aggregate_impl(weights, client_vars)
+        else:
+            num = jax.tree_util.tree_map(
+                lambda leaf: jnp.einsum(
+                    "k,k...->...", weights, leaf.astype(jnp.float32)
+                ),
+                client_vars,
+            )
         den = weights.sum()
         n_participants = participation.sum()
         if axis_name is not None:

@@ -150,6 +150,8 @@ class FedAvgMuxClientManager:
         wrap_backend: Optional[Callable[[CommBackend], CommBackend]] = None,
         rejoin_every_round: bool = False,
         traffic=None,
+        mesh=None,
+        partition_rules=None,
     ):
         self.mux = mux
         # open-loop traffic model (faults/traffic.TrafficModel): every
@@ -189,6 +191,35 @@ class FedAvgMuxClientManager:
         self._cohort_update = jax.jit(
             jax.vmap(local_update.fn, in_axes=(None, 0, 0, 0, 0))
         )
+        # dp×mp mesh path (parallel/partition.py): the SAME vmapped
+        # operator jitted with sharding annotations — cohort rows over
+        # `dp`, the broadcast model laid out by the partition-rule
+        # table over `mp`.  Per-row math is row-independent, so with
+        # mp=1 the sharded step is BIT-identical to the plain one
+        # (pinned by tests/test_shard_rules.py); mp>1 reassociates the
+        # tensor-parallel matmul reductions, which changes bits but
+        # not the model (tests/test_gspmd.py tolerance applies).
+        self._mesh = mesh
+        self._cohort_update_sharded = None
+        if mesh is not None:
+            from fedml_tpu.parallel.partition import (
+                FEDLLM_RULES, cohort_shardings, jit_sharded, resolve_rules,
+            )
+
+            table = (resolve_rules(partition_rules)
+                     if isinstance(partition_rules, str)
+                     else (partition_rules or FEDLLM_RULES))
+            var_in, data_sh, var_out, metrics_sh = cohort_shardings(
+                mesh, template_variables, table
+            )
+            self._cohort_update_sharded = jit_sharded(
+                jax.vmap(local_update.fn, in_axes=(None, 0, 0, 0, 0)),
+                in_shardings=(var_in, data_sh, data_sh, data_sh, data_sh),
+                out_shardings=(var_out, metrics_sh),
+            )
+            tel = get_telemetry()
+            tel.gauge_set("shard.mesh_dp", int(mesh.shape["dp"]))
+            tel.gauge_set("shard.mesh_mp", int(mesh.shape["mp"]))
         from fedml_tpu.analysis.locks import make_lock
 
         self._pending: List[tuple] = []
@@ -488,7 +519,18 @@ class FedAvgMuxClientManager:
         rngs = jax.vmap(
             lambda s: jax.random.fold_in(k_train, s)
         )(jnp.asarray(slots, jnp.int32))
-        new_stacked, metrics = self._cohort_update(
+        cohort_fn = self._cohort_update
+        if self._cohort_update_sharded is not None:
+            dp = int(self._mesh.shape["dp"])
+            if len(entries) % dp == 0:
+                cohort_fn = self._cohort_update_sharded
+            else:
+                # a cohort the dp axis can't split evenly (chaos
+                # stragglers, churn remainders) takes the replicated
+                # path — correctness identical, just unsharded
+                get_telemetry().inc("shard.cohort_fallbacks",
+                                    reason="indivisible")
+        new_stacked, metrics = cohort_fn(
             variables, x, y, mask, rngs,
         )
         # host-side views once per leaf; per-client rows slice from them

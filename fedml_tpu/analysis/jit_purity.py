@@ -56,6 +56,10 @@ JIT_TRANSFORMS = {
     # the package's version-tolerant shim — call sites import the
     # transform from here, and they are jit roots all the same
     "fedml_tpu.parallel.compat.shard_map",
+    # the partition-rule engine's jit entry point (jax.jit with
+    # NamedSharding annotations): every function compiled through the
+    # sharding subsystem is a jit root for the purity scan too
+    "fedml_tpu.parallel.partition.jit_sharded",
 }
 
 HOST_CLOCKS = {

@@ -285,6 +285,7 @@ class TcpHub:
         "shm_bytes": "_lock",
         "shm_fallbacks": "_lock",
         "shm_hub_copies": "_lock",
+        "zero_copy_forwards": "_lock",
     }
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
@@ -341,6 +342,11 @@ class TcpHub:
         # that copied instead, so the fast path's "no copies" claim is
         # testable rather than assumed
         self.shm_hub_copies = 0
+        # and the fast path itself: queue entries enqueued carrying a
+        # slab PIN (one retain, zero payload copies) — the positive
+        # counterpart of shm_hub_copies, so the zero-copy forward rate
+        # is a measurement, not an absence of evidence
+        self.zero_copy_forwards = 0
         # payloads below this ride inline TCP (policy, not fallback)
         self._shm_min = max(0, int(shm_min_bytes))
         self._max_queue_bytes = max_queue_bytes
@@ -957,6 +963,8 @@ class TcpHub:
                                   tuple(rids) if rids else (receiver,),
                                   region))
                 st.nbytes += nbytes
+                if region is not None:
+                    self.zero_copy_forwards += 1
                 if not st.scheduled:
                     st.scheduled = True
                     wake = True
@@ -965,6 +973,9 @@ class TcpHub:
                 region.release()
             self._count_drop(receiver, msg_type)
             return False
+        if region is not None:
+            get_telemetry().inc("hub.zero_copy_forwards",
+                                msg_type=msg_type or "?")
         if wake:
             self._ready.put((receiver, st))
         return True
@@ -1386,6 +1397,7 @@ class TcpHub:
             "shm_bytes": self.shm_bytes,
             "shm_fallbacks": self.shm_fallbacks,
             "shm_hub_copies": self.shm_hub_copies,
+            "zero_copy_forwards": self.zero_copy_forwards,
         }
 
     def stats(self) -> dict:

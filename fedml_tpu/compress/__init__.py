@@ -25,6 +25,12 @@ from fedml_tpu.compress.codecs import (
     wire_tree_digest,
 )
 from fedml_tpu.compress.error_feedback import ErrorFeedback
+from fedml_tpu.compress.sharded import (
+    sharded_entry_nbytes,
+    sharded_wire_digest,
+    wire_decode_tree_sharded,
+    wire_encode_tree_sharded,
+)
 
 __all__ = [
     "BCAST_STREAM",
@@ -40,7 +46,11 @@ __all__ = [
     "encoded_nbytes",
     "get_codec",
     "roundtrip_tree",
+    "sharded_entry_nbytes",
+    "sharded_wire_digest",
     "wire_decode_tree",
+    "wire_decode_tree_sharded",
     "wire_encode_tree",
+    "wire_encode_tree_sharded",
     "wire_tree_digest",
 ]

@@ -1,0 +1,146 @@
+"""Per-shard wire encode: compress a rule-sharded update WITHOUT
+gathering it.
+
+The plain wire path (``codecs.wire_encode_tree``) flattens each leaf —
+for a model laid out over an ``mp`` mesh axis by the partition-rule
+engine (``parallel/partition.py``) that flatten IS an all-gather, and
+the whole point of sharding (a model bigger than one chip) dies at the
+first compressed upload.  This module encodes each device-local shard
+independently:
+
+- shard enumeration is ``arr.addressable_shards`` deduped by index
+  (replication over ``dp`` yields copies) and sorted by slice start —
+  a platform-independent deterministic order;
+- shard ``j`` of leaf ``i`` draws its codec randomness from
+  ``fold_in(fold_in(key, i), j)`` — so the encoded bytes of a shard
+  are BIT-IDENTICAL to a single-device encode of that shard's slice
+  with the same key (pinned by ``tests/test_shard_rules.py``), and no
+  two shards ever share a stream;
+- only ``shard.data`` (the device-local block) is ever materialized —
+  the full leaf never is, which the byte accounting in
+  ``tools/fed_shard_run.py`` asserts (sum of shard elements == leaf
+  elements, one visit each).
+
+Wire format per leaf: ``{"shards": [{"enc": .., "index": [[lo,hi]..],
+"shape": [..]}, ..], "shape": [..], "dtype": ".."}`` — a strict
+superset of the v2 entry, decodable shard-by-shard into a zeros
+canvas (``wire_decode_tree_sharded``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from fedml_tpu.compress.codecs import LeafCodec, _leaf_keys
+
+PyTree = Any
+
+
+def _norm_index(index, shape) -> Tuple[Tuple[int, int], ...]:
+    """A shard's ``.index`` (tuple of slices, possibly open) as
+    concrete ``(lo, hi)`` bounds."""
+    out = []
+    for sl, n in zip(index, shape):
+        lo = 0 if sl.start is None else int(sl.start)
+        hi = int(n) if sl.stop is None else int(sl.stop)
+        out.append((lo, hi))
+    return tuple(out)
+
+
+def shard_slices(arr) -> List[Tuple[Tuple[Tuple[int, int], ...], Any]]:
+    """Deduped ``(bounds, data)`` pairs for one (possibly sharded)
+    array, sorted by slice start.  Replicated copies (same bounds on
+    several devices) appear once; a host numpy array is one full-cover
+    pseudo-shard, so the encoder is total over both worlds."""
+    shape = np.shape(arr)
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards:
+        full = tuple((0, int(n)) for n in shape)
+        return [(full, arr)]
+    seen: Dict[Tuple, Any] = {}
+    for s in shards:
+        bounds = _norm_index(s.index, shape)
+        seen.setdefault(bounds, s.data)
+    return [(b, seen[b]) for b in sorted(seen)]
+
+
+def wire_encode_tree_sharded(codec: LeafCodec, tree: PyTree,
+                             key) -> List[dict]:
+    """Per-leaf sharded wire entries.  Leaf ``i``'s shard ``j``
+    encodes ``fold_in(fold_in(key, i), j)`` over the DEVICE-LOCAL
+    block only — no gather, and bytes pinned to the single-device
+    encode of the same slice."""
+    import jax
+
+    leaves = jax.tree_util.tree_leaves(tree)
+    out = []
+    for leaf, k_leaf in zip(leaves, _leaf_keys(key, len(leaves))):
+        entry_shards = []
+        for j, (bounds, data) in enumerate(shard_slices(leaf)):
+            k_shard = jax.random.fold_in(k_leaf, j)
+            enc = codec.encode(np.asarray(data), k_shard)
+            enc_np = {name: np.asarray(v) for name, v in enc.items()}
+            entry_shards.append({
+                "enc": codec.wire_pack(enc_np),
+                "index": [[lo, hi] for lo, hi in bounds],
+                "shape": [hi - lo for lo, hi in bounds],
+            })
+        dt = getattr(leaf, "dtype", None)  # np.asarray(leaf) would gather
+        out.append({
+            "shards": entry_shards,
+            "shape": list(np.shape(leaf)),
+            "dtype": str(dt if dt is not None
+                         else np.result_type(type(leaf))),
+        })
+    return out
+
+
+def wire_decode_tree_sharded(codec: LeafCodec, entries: List[dict],
+                             like: PyTree) -> PyTree:
+    """Decode sharded entries into full fp32 leaves on the host: each
+    shard decodes into its slice of a zeros canvas."""
+    import jax
+
+    leaves_like, treedef = jax.tree_util.tree_flatten(like)
+    assert len(entries) == len(leaves_like), \
+        "sharded wire/treedef leaf count mismatch"
+    out = []
+    for e, ref in zip(entries, leaves_like):
+        shape = tuple(e.get("shape") or np.shape(ref))
+        canvas = np.zeros(shape, np.float32)
+        for sh in e["shards"]:
+            bounds = [tuple(b) for b in sh["index"]]
+            sub_shape = tuple(hi - lo for lo, hi in bounds)
+            enc = {name: np.asarray(v) for name, v in sh["enc"].items()}
+            dec = np.asarray(
+                codec.decode(codec.wire_unpack(enc, sub_shape), sub_shape),
+                np.float32,
+            )
+            sel = tuple(slice(lo, hi) for lo, hi in bounds)
+            canvas[sel] = dec
+        out.append(canvas)
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def sharded_entry_nbytes(entry: dict) -> List[int]:
+    """Wire payload bytes per shard of one leaf entry (buffers only)."""
+    return [
+        sum(int(np.asarray(v).nbytes) for v in sh["enc"].values())
+        for sh in entry["shards"]
+    ]
+
+
+def sharded_wire_digest(entries: List[dict]) -> str:
+    """sha256 over every shard's payload buffers in (leaf, shard)
+    order — the sharded sibling of ``codecs.wire_tree_digest``."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for e in entries:
+        for sh in e["shards"]:
+            for name in sorted(sh["enc"]):
+                h.update(np.ascontiguousarray(
+                    np.asarray(sh["enc"][name])).tobytes())
+    return h.hexdigest()

@@ -307,6 +307,8 @@ def device_resident_pack(
     *,
     steps_per_epoch: int,
     seed: int,
+    mesh=None,
+    cohort_axis: str = "dp",
 ) -> Tuple[Tuple, np.ndarray]:
     """Pack a cohort ONCE and put it on device for the whole run — the
     shared primitive behind every driver's resident-cohort cache
@@ -316,6 +318,13 @@ def device_resident_pack(
     Returns ``((x, y, mask, num_samples) device arrays, host
     num_samples)`` — callers that weight aggregation on host keep the
     numpy copy instead of reading the device array back every round.
+
+    ``mesh`` (a dp×mp mesh from ``parallel/mesh.py``) shards the
+    leading client axis of every packed array over ``cohort_axis``
+    instead of leaving the block on one device — each dp slice of the
+    mesh then holds only its own clients' rows, which is what lets the
+    partition-rule round engine scale the resident cohort past one
+    chip's HBM.
 
     ``reuse_buffers`` only off-CPU: the TPU device_put is a real copy,
     so the reused host buffer is free once block_until_ready returns
@@ -331,10 +340,19 @@ def device_resident_pack(
         seed=seed, reuse_buffers=jax.default_backend() != "cpu",
     )
     host_ns = np.asarray(pack.num_samples).copy()
-    args = tuple(
-        jax.device_put(jnp.asarray(a))
-        for a in (pack.x, pack.y, pack.mask, pack.num_samples)
-    )
+    if mesh is not None:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        target = NamedSharding(mesh, PartitionSpec(cohort_axis))
+        args = tuple(
+            jax.device_put(np.asarray(a), target)
+            for a in (pack.x, pack.y, pack.mask, pack.num_samples)
+        )
+    else:
+        args = tuple(
+            jax.device_put(jnp.asarray(a))
+            for a in (pack.x, pack.y, pack.mask, pack.num_samples)
+        )
     jax.block_until_ready(args)
     return args, host_ns
 

@@ -577,6 +577,11 @@ def run_muxer(args) -> None:
                     for n in node_ids)
         if r is not None
     ]
+    mesh = None
+    if getattr(args, "mesh", ""):
+        from fedml_tpu.parallel.mesh import mesh_from_spec
+
+        mesh = mesh_from_spec(args.mesh)
     mgr = FedAvgMuxClientManager(
         mux, lu, ds, batch_size=args.batch_size,
         template_variables=init, seed=args.seed,
@@ -585,6 +590,8 @@ def run_muxer(args) -> None:
         wrap_backend=wrap,
         rejoin_every_round=args.rejoin_every_round,
         traffic=_traffic_model("muxer"),
+        mesh=mesh,
+        partition_rules=getattr(args, "partition_rules", "") or None,
     )
     mlog = _node_metrics_logger(args.run_dir, f"mux{args.node_id}")
     _install_flight(args.run_dir, f"mux{args.node_id}")
@@ -722,6 +729,8 @@ def launch(
     chaos_plan: str = "",
     codec: str = "none",
     wire: int = 2,
+    mesh: str = "",
+    partition_rules: str = "",
     input_dim: int = 8,
     lane: str = "tcp",
     shm_mib: int = 64,
@@ -864,6 +873,12 @@ def launch(
             + rd_flags
         if codec and codec != "none":
             common += ["--codec", codec]
+        if mesh:
+            # muxer cohorts step on a dp x mp device mesh; other roles
+            # ignore the flags (same pattern as --codec)
+            common += ["--mesh", mesh]
+        if partition_rules:
+            common += ["--partition-rules", partition_rules]
         if wire != 2:
             common += ["--wire", str(wire)]
         if input_dim != 8:
@@ -1184,6 +1199,12 @@ def main(argv=None):
     # of the bytes measurement); --input-dim scales the model so byte
     # ratios measure payload, not envelope.
     p.add_argument("--codec", default="none")
+    # rule-driven sharding (parallel/partition.py): the muxer lays its
+    # virtual cohort over dp and the model over mp in ONE jit step;
+    # other roles accept and ignore the flags (launch() appends them to
+    # the shared flag block, like --codec)
+    p.add_argument("--mesh", default="")
+    p.add_argument("--partition-rules", default="")
     p.add_argument("--wire", type=int, choices=[1, 2], default=2)
     p.add_argument("--input-dim", type=int, default=8)
     # raw-speed transport knobs (fedml_tpu/comm/shm.py +

@@ -86,6 +86,15 @@ class ExperimentConfig:
     num_layers: int = 2
     tp_degree: int = 1  # >1: DP x TP on a (clients, model) device mesh
     sp_degree: int = 1  # >1: DP x SP — long-context clients, ring attention
+    # rule-driven sharding engine (fedml_tpu/parallel/partition.py):
+    # --mesh "dp,mp" (also "dp=4,mp=2" / "auto,2") lays the cohort over
+    # dp and the model over mp in ONE jit step; --partition_rules picks
+    # the (regex -> PartitionSpec) table: a canonical name (fedllm,
+    # resnet) or a JSON rule file.  Exclusive with tp/sp_degree;
+    # composes with compress/compress_ef (the residual store shards
+    # client rows over dp).
+    mesh: str = ""
+    partition_rules: str = ""
     # beyond-reference knobs available on the FedAvg-engine family
     compute_dtype: str = ""  # "bf16" = mixed-precision local training
     drop_prob: float = 0.0  # failure injection: P(client dies mid-round)
@@ -179,7 +188,12 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn, metrics=None) -> dict:
         num_layers=cfg.num_layers, seq_len=seq_len,
     )
 
-    if cfg.tp_degree <= 1 and cfg.sp_degree <= 1:
+    if cfg.mesh and (cfg.tp_degree > 1 or cfg.sp_degree > 1):
+        raise ValueError(
+            "--mesh is the rule-driven sharding engine and is exclusive "
+            "with tp_degree/sp_degree (those pick the heuristic meshes)"
+        )
+    if cfg.tp_degree <= 1 and cfg.sp_degree <= 1 and not cfg.mesh:
         from fedml_tpu.algorithms.fedavg import FedAvgConfig, FedAvgSimulation
 
         sim = FedAvgSimulation(bundle, ds, FedAvgConfig(
@@ -206,13 +220,19 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn, metrics=None) -> dict:
             "clients x model x sp mesh is not wired up)"
         )
     K = min(cfg.client_num_per_round, ds.num_clients)
-    degree = cfg.tp_degree if cfg.tp_degree > 1 else cfg.sp_degree
-    if jax.device_count() % degree:
-        raise ValueError(
-            f"parallel degree {degree} does not divide device count "
-            f"{jax.device_count()}"
-        )
-    dp = jax.device_count() // degree
+    if cfg.mesh:
+        from fedml_tpu.parallel.mesh import mesh_from_spec
+
+        rule_mesh = mesh_from_spec(cfg.mesh)
+        dp = int(rule_mesh.shape["dp"])
+    else:
+        degree = cfg.tp_degree if cfg.tp_degree > 1 else cfg.sp_degree
+        if jax.device_count() % degree:
+            raise ValueError(
+                f"parallel degree {degree} does not divide device count "
+                f"{jax.device_count()}"
+            )
+        dp = jax.device_count() // degree
     if K % dp:
         raise ValueError(f"cohort {K} not divisible by dp width {dp}")
     opt = make_client_optimizer(
@@ -222,7 +242,45 @@ def _run_fedllm(cfg: ExperimentConfig, ds, t0, log_fn, metrics=None) -> dict:
     cdtype = resolve_compute_dtype(cfg.compute_dtype or None)
     key = jax.random.PRNGKey(cfg.seed)
 
-    if cfg.tp_degree > 1:
+    if cfg.mesh:
+        from fedml_tpu.parallel.partition import (
+            make_rule_round_fn, resolve_rules,
+        )
+
+        table = resolve_rules(cfg.partition_rules or "fedllm")
+        lu = make_local_update(
+            bundle, opt, epochs=cfg.epochs, compute_dtype=cdtype,
+        )
+        variables = bundle.init(key)
+        codec = cfg.compress or None
+        ef = bool(cfg.compress_ef) and codec is not None
+        residuals = ()
+        if ef:
+            # EF residual store rows shard over dp alongside the cohort
+            if ds.num_clients % dp:
+                raise ValueError(
+                    f"client_num_in_total {ds.num_clients} not divisible "
+                    f"by dp width {dp} (the EF residual store shards its "
+                    f"client rows over dp)"
+                )
+            residuals = jax.tree_util.tree_map(
+                lambda l: jnp.zeros(
+                    (ds.num_clients,) + l.shape, jnp.float32
+                ),
+                variables,
+            )
+        state = ServerState(
+            variables=variables, opt_state=(),
+            round_idx=jnp.zeros((), jnp.int32), key=key,
+            residuals=residuals,
+        )
+        round_fn, shard_state, shard_data = make_rule_round_fn(
+            rule_mesh, lu, variables, table,
+            codec=codec, error_feedback=ef,
+        )
+        state = shard_state(state)
+        mesh = rule_mesh
+    elif cfg.tp_degree > 1:
         from fedml_tpu.parallel.gspmd import (
             make_dp_tp_mesh, make_dp_tp_round_fn,
         )

@@ -123,7 +123,10 @@ class TransformerLM(nn.Module):
         for i in range(self.num_layers):
             h = block_cls(self.num_heads, attn_fn=self.attn_fn,
                           name=f"Block_{i}")(h)
-        h = nn.LayerNorm()(h)
+        # named so partition-rule tables (parallel/partition.py) can
+        # address the final norm distinctly from the blocks' auto-named
+        # LayerNorm_{0,1} — the GPT convention
+        h = nn.LayerNorm(name="ln_f")(h)
         # weight-tied head
         return tok.attend(h)
 

@@ -84,9 +84,16 @@ SCHEMA = 1
 
 ENV_DISABLE = "FEDML_TPU_FLIGHT"          # "0" switches recording off
 ENV_WINDOW = "FEDML_TPU_FLIGHT_WINDOW_S"  # dump window override
+ENV_LOCK_WAIT = "FEDML_TPU_FLIGHT_LOCK_WAIT_S"  # lock-ring wait threshold
 
 DEFAULT_WINDOW_S = 60.0
 DEFAULT_MIN_INTERVAL_S = 1.0  # per-trigger-kind dump rate limit
+# lock acquires below this measured block time never reach the ring or
+# the histogram: an uncontended CheckedLock acquire still takes ~1 us,
+# and a 1024-deep ring of those evicts the contended rows forensics
+# actually ranks.  1 ms keeps scheduler-quantum-scale contention and
+# drops lock-free chatter.
+DEFAULT_LOCK_WAIT_S = 1e-3
 
 # ring depths: sized so the busiest category (per-frame comm metadata)
 # holds several rounds of a large federation while the whole recorder
@@ -135,6 +142,11 @@ class FlightRecorder:
             except ValueError:
                 window_s = DEFAULT_WINDOW_S
         self.window_s = window_s
+        try:
+            self.lock_wait_s = float(
+                os.environ.get(ENV_LOCK_WAIT, DEFAULT_LOCK_WAIT_S))
+        except ValueError:
+            self.lock_wait_s = DEFAULT_LOCK_WAIT_S
         d = dict(DEPTHS)
         d.update(depths or {})
         self._rings: Dict[str, deque] = {c: deque(maxlen=n)
@@ -181,12 +193,22 @@ class FlightRecorder:
 
     def _on_lock(self, name: str, depth: int,
                  wait_s: float = 0.0) -> None:
-        # ``wait_s`` is the CheckedLock tap's measured block time: the
-        # lock-wait ring doubles as a contention profile (fed_forensics
-        # ranks locks by total/max wait) — rows with wait_s == 0 are
-        # uncontended acquires and still chart acquisition ORDER
+        # ``wait_s`` is the CheckedLock tap's measured block time.  The
+        # ring is a CONTENTION profile (fed_forensics ranks locks by
+        # total/max wait), so only waits past the threshold
+        # (``FEDML_TPU_FLIGHT_LOCK_WAIT_S``, default 1 ms) are kept —
+        # uncontended acquires would evict the rows that matter.  Each
+        # kept wait also lands in the ``lock.wait_s`` histogram so the
+        # telemetry digest carries the contention shape even when no
+        # dump ever fires.
+        if wait_s < self.lock_wait_s:
+            return
         self.record("locks", "acquire", lock=name, depth=depth,
                     wait_s=wait_s)
+        try:
+            get_telemetry().observe("lock.wait_s", wait_s, lock=name)
+        except Exception:
+            pass
 
     # -- configuration ------------------------------------------------------
     def configure(self, run_dir: Optional[str], node: str) -> None:

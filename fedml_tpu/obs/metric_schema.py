@@ -78,6 +78,8 @@ COUNTERS = {
     "flight.dumps": "flight-recorder bundles written {trigger=}",
     "flight.dumps_suppressed": "dumps skipped by the per-trigger rate limit or a dump already in flight {trigger=}",
     "flight.dump_errors": "bundle writes that failed (fs errors; recording continues)",
+    "hub.zero_copy_forwards": "laned frames enqueued as refcounted slab pins (no materialize copy) {msg_type=}",
+    "shard.cohort_fallbacks": "muxed cohorts trained on the unsharded path {reason=}",
     "jax.compiles": "jit compilations per instrumented fn {fn=}",
     "jax.backend_compile_events": "runtime jax.monitoring compile events {event=}",
 }
@@ -103,6 +105,8 @@ GAUGES = {
     "digest.streams": "distinct digest source streams the rollup has seen",
     "clock.hub_offset_s": "estimated monotonic-clock offset to the hub {node=}",
     "clock.hub_rtt_s": "min round-trip of the clock-sync burst {node=}",
+    "shard.mesh_dp": "dp (cohort) axis width of the partition-rule mesh",
+    "shard.mesh_mp": "mp (model) axis width of the partition-rule mesh",
 }
 
 # --- histograms (log2-bucketed; Telemetry.observe) ---------------------------
@@ -125,6 +129,7 @@ HISTOGRAMS = {
     "jax.compile_s": "wall time of compile-triggering calls {fn=}",
     "jax.backend_compile_s": "runtime-reported compile durations {event=}",
     "flight.dump_write_s": "atomic flight-bundle write (snapshot + json + replace)",
+    "lock.wait_s": "CheckedLock acquire block time past the flight threshold {lock=}",
 }
 
 # --- dynamic-name patterns ---------------------------------------------------

@@ -50,14 +50,21 @@ def opt_state_sharding_like(
     variables_template: PyTree,
     opt_state_template: PyTree,
     axis: str = "model",
+    *,
+    pspec: Optional[PyTree] = None,
 ) -> PyTree:
     """Sharding tree for server-optimizer state whose leaves mirror the
     parameters (FedAdam/FedYogi moments): each opt leaf with the shape
     of some param leaf inherits that param's TP spec; everything else
     (counts, scalars) is replicated.  Shape-based matching is a
     heuristic — two same-shaped params with different specs resolve to
-    whichever appears first, which only changes layout, not values."""
-    pspec = tp_param_spec(variables_template, axis)
+    whichever appears first, which only changes layout, not values.
+
+    ``pspec`` overrides the param spec tree (the partition-rule engine
+    in ``parallel/partition.py`` passes its rule-derived specs here);
+    the default keeps the transformer TP heuristic."""
+    if pspec is None:
+        pspec = tp_param_spec(variables_template, axis)
     shape_to_spec = {}
     for leaf, spec in zip(
         jax.tree_util.tree_leaves(variables_template),

@@ -504,4 +504,42 @@ def test_lock_wait_tap_records_edge_fold_contention(tmp_path):
     assert ent, f"fold lock missing from contention ranking: {top}"
     assert ent[0]["contended"] >= 1
     assert ent[0]["wait_max_s"] >= 0.05
-    assert ent[0]["acquires"] >= 2
+    # the holder's own uncontended acquire is GATED out of the ring
+    # (below the wait threshold): only the blocked acquire is recorded
+    assert ent[0]["acquires"] == 1
+
+
+def test_lock_wait_ring_threshold_gates_and_feeds_histogram(tmp_path):
+    """The lock ring is a contention profile: acquires below the
+    ``FEDML_TPU_FLIGHT_LOCK_WAIT_S`` threshold never reach it (they
+    would evict the contended rows), waits past it land in the ring AND
+    the ``lock.wait_s`` histogram."""
+    from fedml_tpu.obs.telemetry import get_telemetry
+
+    r = _fresh(tmp_path, tag="nodeL")
+    assert r.lock_wait_s == flight.DEFAULT_LOCK_WAIT_S
+    tel = get_telemetry()
+    before = sum(h.count for k, h in tel.hists.items()
+                 if k.startswith("lock.wait_s"))
+    # uncontended-scale wait: gated out of ring and histogram
+    r._on_lock("Hub._lock", 1, wait_s=1e-6)
+    # contended wait: recorded in both
+    r._on_lock("Hub._lock", 1, wait_s=0.05)
+    b = json.loads(Path(r.dump("manual", force=True)).read_text())
+    rows = [row for row in b["rings"]["locks"]
+            if row.get("lock") == "Hub._lock"]
+    assert len(rows) == 1 and rows[0]["wait_s"] == 0.05
+    after = {k: h for k, h in tel.hists.items()
+             if k.startswith("lock.wait_s")}
+    assert sum(h.count for h in after.values()) == before + 1
+    assert any("lock=Hub._lock" in k for k in after)
+
+
+def test_lock_wait_threshold_env_override(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.ENV_LOCK_WAIT, "0.5")
+    r = _fresh(tmp_path, tag="nodeL2")
+    assert r.lock_wait_s == 0.5
+    r._on_lock("Hub._lock", 1, wait_s=0.1)  # below the raised bar
+    b = json.loads(Path(r.dump("manual", force=True)).read_text())
+    assert not [row for row in b["rings"]["locks"]
+                if row.get("lock") == "Hub._lock"]

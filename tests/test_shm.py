@@ -481,6 +481,12 @@ def test_hub_routes_laned_payloads_zero_copy():
             "hub materialized a laned payload on the healthy path: "
             f"{stats}"
         )
+        # the positive counterpart: the laned frame was ENQUEUED as a
+        # refcounted pin — the zero-copy claim is a counted event, not
+        # just the absence of copies
+        assert stats["zero_copy_forwards"] > 0, (
+            f"laned frame never counted as a zero-copy forward: {stats}"
+        )
     finally:
         hub.stop()
 

@@ -412,13 +412,21 @@ class ShmLane:
         finally:
             region.release()
 
-    def inbound_backlog(self) -> int:
-        """Inbound frames read but not yet fully released (live pins).
-        Ring reclamation is in-order, so ONE long-lived pin holds every
-        later frame's bytes too — consumers with queue-length retention
-        (the hub router) use this to decide pin vs materialize."""
+    def live_pins(self) -> int:
+        """Inbound regions read but not yet released to zero — the
+        leak-test observable: after a churn/rebind soak every queued
+        pin must have been released (sent, dropped, or flushed by conn
+        cleanup) and this must read 0.  Same protocol + meaning as
+        ``reactor.BufPool.live`` for pooled TCP payload buffers."""
         with self._rlock:
             return len(self._outstanding)
+
+    def inbound_backlog(self) -> int:
+        """``live_pins()`` under its routing-decision name: ring
+        reclamation is in-order, so ONE long-lived pin holds every
+        later frame's bytes too — consumers with queue-length retention
+        (the hub router) use this to decide pin vs materialize."""
+        return self.live_pins()
 
     def _release_seq(self, seq: int) -> None:
         with self._rlock:

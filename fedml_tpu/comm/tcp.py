@@ -16,11 +16,27 @@ id.  Two frame generations share the stream:
   treat the line as a complete v1 frame, so both generations interop on
   one hub and the 4/3x base64 inflation is gone from model traffic.
 
-Design notes vs the reference's MPI threads (SURVEY.md §5.2): one
-blocking reader thread per connection, shutdown via sentinel frame and
-socket close — no ctypes thread kills, no polling sleeps.  A dead or
-misbehaving peer only loses its own frames: routing errors are caught,
-the stale connection is dropped, and other nodes keep flowing.
+Design notes vs the reference's MPI threads (SURVEY.md §5.2): the hub
+data plane runs in one of two modes (``FEDML_TPU_HUB_MODE``, or the
+``mode=`` kwarg):
+
+- **reactor** (default) — a single ``selectors``-based event-loop
+  thread multiplexes every connection non-blocking: accepts, streaming
+  header/payload reads (``comm/reactor.py``'s ``FrameParser``), shm
+  doorbells, and writability-driven queue drains.  O(1) threads per
+  hub regardless of connection count — the scaling plane for the
+  512-conn-and-up regime — and inbound TCP payloads land in pooled
+  refcounted buffers (``BufRegion``) that ride the send queues as
+  pins, so routing is zero-copy end to end on BOTH transports.
+- **threaded** — the original one-blocking-reader-thread-per-connection
+  model with a sender pool; kept as the A/B byte-identity baseline
+  (both modes build frame bytes through the same drain helpers, so
+  federations pin sha256-identical across them).
+
+Either way: shutdown via sentinel frame and socket close — no ctypes
+thread kills, no polling sleeps.  A dead or misbehaving peer only
+loses its own frames: routing errors are caught, the stale connection
+is dropped, and other nodes keep flowing.
 """
 
 from __future__ import annotations
@@ -29,7 +45,9 @@ import hashlib
 import itertools
 import json
 import logging
+import os
 import queue
+import selectors
 import socket
 import threading
 import time
@@ -47,6 +65,7 @@ from fedml_tpu.comm.message import (
     SHM_SEQ_KEY,
     Message,
 )
+from fedml_tpu.comm.reactor import BufPool, FrameError, FrameParser
 from fedml_tpu.comm.shm import (
     DEFAULT_DATA_BYTES,
     DEFAULT_MIN_BYTES,
@@ -60,6 +79,18 @@ from fedml_tpu.obs.telemetry import get_telemetry
 
 _SENTINEL = {HUB_KEY: "stop"}
 _ACK = {HUB_KEY: "ack"}
+
+# hub data-plane selection: "reactor" (selector event loop, default) or
+# "threaded" (one blocking reader thread per connection + sender pool)
+ENV_HUB_MODE = "FEDML_TPU_HUB_MODE"
+
+
+class _HubConnError(Exception):
+    """Connection-fatal condition noticed mid-serve (lane errors,
+    doorbells on lane-less conns, invalid hellos): the reactor and the
+    threaded reader both translate it into 'drop this connection',
+    which is the established policy for every unrecoverable per-conn
+    fault — the peer pays one reconnect, the router never wedges."""
 
 
 def _retry_jitter(node_id: int, attempt: int) -> float:
@@ -211,10 +242,24 @@ class _Conn:
     streaming within one head round instead of the last one waiting
     behind K-1 whole fan-outs (enqueue order alone cannot guarantee
     this — tails land while heads are still draining and a paced visit
-    would drain head+tail together)."""
+    would drain head+tail together).
+
+    Reactor-plane fields (``parser``..``want_write``) exist on every
+    conn but are live only under ``mode="reactor"``, where they are
+    touched EXCLUSIVELY by the event-loop thread (single-threaded by
+    construction — the reactor's analogue of the single-drainer rule):
+    ``parser`` is the conn's streaming ``FrameParser``, ``phase`` the
+    handshake state machine (0 = awaiting hello, 1 = clock-sync, 2 =
+    registered), ``wpend`` the partial-write continuation (memoryviews
+    of the in-flight frame still to write), ``wcommit``/``wregion``
+    the lane-commit token and payload pin released when that frame
+    fully flushes, and ``want_write`` whether the socket is parked on
+    EVENT_WRITE."""
 
     __slots__ = ("sock", "frames", "heads", "nbytes", "scheduled",
-                 "ids", "ranges", "mux", "cid", "dead", "lane")
+                 "ids", "ranges", "mux", "cid", "dead", "lane",
+                 "parser", "phase", "nid0", "wpend", "wcommit",
+                 "wregion", "wmsg_type", "want_write")
 
     def __init__(self, sock: socket.socket, ids=(), mux: bool = False,
                  lane=None, ranges=()):
@@ -241,6 +286,15 @@ class _Conn:
         # directions ride its rings while every header stays on this
         # socket (order, control frames, and fallback are the stream's)
         self.lane = lane
+        # reactor-plane state (loop-thread-only; see class docstring)
+        self.parser = None
+        self.phase = 0
+        self.nid0 = None  # the hello's primary node id (reply target)
+        self.wpend: List = []
+        self.wcommit = None
+        self.wregion = None
+        self.wmsg_type = None
+        self.want_write = False
 
     def covers(self, nid: int) -> bool:
         """True when this conn routes ``nid`` (per-id claim or range)."""
@@ -286,13 +340,21 @@ class TcpHub:
         "shm_fallbacks": "_lock",
         "shm_hub_copies": "_lock",
         "zero_copy_forwards": "_lock",
+        "_drainq": "_lock",
+        "_reader_count": "_lock",
     }
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  senders: int = 4, max_queue_bytes: int = 256 << 20,
                  max_queue_frames: int = 4096,
                  stripe_bytes: int = 0, max_inflight_stripes: int = 8,
-                 shm_min_bytes: int = DEFAULT_MIN_BYTES):
+                 shm_min_bytes: int = DEFAULT_MIN_BYTES,
+                 mode: Optional[str] = None):
+        self._mode = (mode or os.environ.get(ENV_HUB_MODE)
+                      or "reactor").strip().lower()
+        if self._mode not in ("reactor", "threaded"):
+            raise ValueError(f"unknown hub mode {self._mode!r} "
+                             f"(want 'reactor' or 'threaded')")
         self._srv = socket.create_server((host, port))
         self.host, self.port = self._srv.getsockname()
         # striped fan-out: an mcast payload larger than ``stripe_bytes``
@@ -364,14 +426,47 @@ class TcpHub:
         self._lock = make_lock("TcpHub._lock")
         self._ready: "queue.SimpleQueue" = queue.SimpleQueue()
         self._running = True
-        self._senders = [
-            threading.Thread(target=self._sender_loop, daemon=True)
-            for _ in range(max(1, int(senders)))
-        ]
-        for t in self._senders:
-            t.start()
-        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
-        self._accept_thread.start()
+        self._reader_count = 0  # live threaded-mode reader threads
+        self._senders: List[threading.Thread] = []
+        if self._mode == "threaded":
+            self._senders = [
+                threading.Thread(target=self._sender_loop, daemon=True)
+                for _ in range(max(1, int(senders)))
+            ]
+            for t in self._senders:
+                t.start()
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, daemon=True)
+            self._accept_thread.start()
+            return
+        # reactor mode: ONE event-loop thread owns accepts, reads, and
+        # writability-driven drains for every connection.  The wakeup
+        # pipe is the documented off-loop entry point: stop() and any
+        # cross-thread _forward tickle it so the sleeping select wakes
+        # and services the drain queue (chaos timer-delayed deliveries
+        # need no special path — they arrive as ordinary socket
+        # readability, see README "Federation transport").
+        self._srv.setblocking(False)
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._srv, selectors.EVENT_READ, None)
+        self._wakeup_r, self._wakeup_w = os.pipe()
+        os.set_blocking(self._wakeup_r, False)
+        os.set_blocking(self._wakeup_w, False)
+        self._sel.register(self._wakeup_r, selectors.EVENT_READ,
+                           "wakeup")
+        # conns with newly-enqueued frames awaiting a drain visit
+        # (scheduled=True dedups; drained at each loop batch end)
+        self._drainq: deque = deque()
+        self._bufpool = BufPool()
+        # ONE recv scratch for every connection's parser: the loop
+        # reads one socket at a time and scratch bytes never outlive a
+        # consumed() call, so sharing it keeps reactor RSS flat in the
+        # connection count (see FrameParser.__init__)
+        self._rx_scratch = bytearray(256 << 10)
+        self._loop_tid = None
+        self._loop_thread = threading.Thread(
+            target=self._reactor_loop, daemon=True)
+        self._loop_thread.start()
 
     def _accept_loop(self):
         while self._running:
@@ -383,11 +478,61 @@ class TcpHub:
                 target=self._serve_conn, args=(conn,), daemon=True
             ).start()
 
+    @staticmethod
+    def _parse_hello(hello_obj: dict):
+        """Decode a hello frame into ``(ids, ranges, mux, node_id)``.
+        Raises ``_HubConnError`` for an empty/inverted claim — nothing
+        to route, the connection is useless by its own admission."""
+        if "node_ranges" in hello_obj:
+            # hello v2 RANGE claim (edge-hub uplink): the conn owns
+            # whole contiguous id intervals.  No per-id entries are
+            # materialized — at 100k virtual clients behind 4 edges
+            # the per-id form costs the ROOT hub ~33 MB of node-map
+            # + hello-parse state that exists only to say "these
+            # 25000 consecutive ids live here"
+            ranges = [(int(lo), int(hi))
+                      for lo, hi in hello_obj["node_ranges"]]
+            if not ranges or any(lo > hi for lo, hi in ranges):
+                raise _HubConnError("empty/inverted range claim")
+            return [], ranges, True, ranges[0][0]
+        if "node_ids" in hello_obj:
+            # hello v2: one connection registers MANY node ids (a
+            # muxer's virtual clients); v1 dialers keep sending the
+            # single node_id form and both interop on one hub
+            ids = [int(i) for i in hello_obj["node_ids"]]
+            if not ids:
+                raise _HubConnError("empty registration")
+            return ids, [], True, ids[0]  # ids[0]: peers replies, logs
+        nid = int(hello_obj["node_id"])
+        return [nid], [], False, nid
+
+    @staticmethod
+    def _attach_lane(hello_obj: dict, node_id):
+        """shm-lane capability (hello key "shm"): the dialer created
+        a slab and advertises it; attach if we can reach it (the
+        same-box test IS the attach — a cross-host name simply
+        doesn't exist here) and confirm in the ACK.  Any failure
+        downgrades the connection to pure TCP, never an error."""
+        shm_desc = hello_obj.get("shm")
+        if not isinstance(shm_desc, dict):
+            return None
+        try:
+            return ShmLane.attach(shm_desc)
+        except Exception as e:
+            logging.warning(
+                "hub: shm attach for node %s failed (%s: %s) — "
+                "connection stays pure TCP", node_id,
+                type(e).__name__, e,
+            )
+            return None
+
     def _serve_conn(self, conn: socket.socket):
         node_id = None
         ids: List[int] = []
         st = None
         lane = None
+        with self._lock:
+            self._reader_count += 1
         try:
             _tune_socket(conn)
             f = conn.makefile("rb")
@@ -395,51 +540,11 @@ class TcpHub:
             if not hello:
                 return
             hello_obj = json.loads(hello)
-            ranges: List[tuple] = []
-            if "node_ranges" in hello_obj:
-                # hello v2 RANGE claim (edge-hub uplink): the conn owns
-                # whole contiguous id intervals.  No per-id entries are
-                # materialized — at 100k virtual clients behind 4 edges
-                # the per-id form costs the ROOT hub ~33 MB of node-map
-                # + hello-parse state that exists only to say "these
-                # 25000 consecutive ids live here"
-                ranges = [(int(lo), int(hi))
-                          for lo, hi in hello_obj["node_ranges"]]
-                if not ranges or any(lo > hi for lo, hi in ranges):
-                    return  # empty/inverted claim: nothing to route
-                ids = []
-                mux = True
-                node_id = ranges[0][0]
-            elif "node_ids" in hello_obj:
-                # hello v2: one connection registers MANY node ids (a
-                # muxer's virtual clients); v1 dialers keep sending the
-                # single node_id form and both interop on one hub
-                ids = [int(i) for i in hello_obj["node_ids"]]
-                mux = True
-                if not ids:
-                    return  # empty registration: nothing to route
-                node_id = ids[0]  # primary id: peers replies, logging
-            else:
-                ids = [int(hello_obj["node_id"])]
-                mux = False
-                node_id = ids[0]
-            # shm-lane capability (hello key "shm"): the dialer created
-            # a slab and advertises it; attach if we can reach it (the
-            # same-box test IS the attach — a cross-host name simply
-            # doesn't exist here) and confirm in the ACK.  Any failure
-            # downgrades the connection to pure TCP, never an error.
-            lane = None
-            shm_desc = hello_obj.get("shm")
-            if isinstance(shm_desc, dict):
-                try:
-                    lane = ShmLane.attach(shm_desc)
-                except Exception as e:
-                    logging.warning(
-                        "hub: shm attach for node %s failed (%s: %s) — "
-                        "connection stays pure TCP", node_id,
-                        type(e).__name__, e,
-                    )
-                    lane = None
+            try:
+                ids, ranges, mux, node_id = self._parse_hello(hello_obj)
+            except _HubConnError:
+                return
+            lane = self._attach_lane(hello_obj, node_id)
             # ACK BEFORE registering: once registered, the sender pool
             # may write to this conn concurrently, and an ACK
             # interleaved with a routed frame would hand the dialing
@@ -482,65 +587,7 @@ class TcpHub:
                 # registration and let the main loop service this line
                 break
             st = _Conn(conn, ids=ids, mux=mux, lane=lane, ranges=ranges)
-            rebound: List[int] = []
-            stale_conns: List[_Conn] = []
-            with self._lock:
-                st.cid = next(self._cids)
-                # range conns are DISPLACED AS ATOMS: any overlap with a
-                # new claim (id or range) kills the old conn's whole
-                # claim — an edge IS its cohort; there is no id-by-id
-                # partial rebind of a range.  Counted per covered id so
-                # the rebind series stays comparable across claim forms.
-                claimed_ranges = st.ranges
-                for rc in [c for c in self._range_conns if c is not st]:
-                    hit = any(rc.covers(nid) for nid in ids) or any(
-                        lo <= rhi and rlo <= hi
-                        for lo, hi in claimed_ranges
-                        for rlo, rhi in rc.ranges)
-                    if hit:
-                        self.node_rebinds += rc.claimed()
-                        rc.dead = True
-                        self._range_conns.remove(rc)
-                        stale_conns.append(rc)
-                        logging.warning(
-                            "hub: range claim %s displaces conn cid=%s "
-                            "(ranges %s) entirely — rebind",
-                            claimed_ranges or ids[:8], rc.cid, rc.ranges,
-                        )
-                if claimed_ranges:
-                    # a new RANGE claim also steals any per-id claims it
-                    # covers (same new-conn-wins policy; the node map is
-                    # small wherever range claims happen — the root tier)
-                    for nid, old in list(self._conns.items()):
-                        if old is not st and st.covers(nid):
-                            self.node_rebinds += 1
-                            rebound.append(nid)
-                            old.ids.discard(nid)
-                            del self._conns[nid]
-                            if not old.ids and not old.ranges:
-                                old.dead = True
-                                stale_conns.append(old)
-                    self._range_conns.append(st)
-                for nid in ids:
-                    old = self._conns.get(nid)
-                    if old is not None and old is not st:
-                        # rebind policy (pinned): the NEW conn wins the
-                        # id; the old conn loses it and dies entirely
-                        # once it holds no ids — counted, never silent
-                        self.node_rebinds += 1
-                        rebound.append(nid)
-                        old.ids.discard(nid)
-                        if not old.ids:
-                            old.dead = True
-                            stale_conns.append(old)
-                    self._conns[nid] = st
-            tel = get_telemetry()
-            for nid in rebound:
-                tel.inc("hub.node_rebinds")
-                logging.warning(
-                    "hub: node %s re-registered on a new connection — "
-                    "the old connection loses it (rebind)", nid,
-                )
+            stale_conns = self._register_conn(st, ids)
             for old in stale_conns:
                 # drop the fully-displaced conn: its reader sees EOF and
                 # cleans up; queued frames die with it (straggler
@@ -582,54 +629,18 @@ class TcpHub:
                 # forwards header+payload as ONE unit and the readline
                 # loop never parses payload bytes as lines.  A header
                 # carrying the shm doorbell key maps the payload out of
-                # the connection's slab instead (one copy into hub
-                # memory — routing queues outlive this read scope); a
-                # torn descriptor is connection-fatal, exactly like a
-                # garbled header.
+                # the connection's slab instead; a torn descriptor is
+                # connection-fatal, exactly like a garbled header.
                 payload = b""
                 region = None
                 binlen = frame.get(FRAME_BINLEN_KEY)
                 sseq = frame.pop(SHM_SEQ_KEY, None)
                 if binlen and sseq is not None:
-                    if st.lane is None:
-                        logging.warning(
-                            "hub: node %s sent an shm doorbell on a "
-                            "lane-less connection — dropping it", node_id,
-                        )
-                        break
                     try:
-                        if (st.lane.inbound_backlog() * 2
-                                >= st.lane.nslots):
-                            # pin-pressure valve: ring reclamation is
-                            # in-order, so pins parked in one slow
-                            # conn's send queue hold every LATER
-                            # frame's bytes too.  With half the
-                            # descriptor slots still pinned,
-                            # materialize this frame (one copy,
-                            # counted) instead of letting the writer's
-                            # ring stall into inline-TCP fallbacks.
-                            payload = st.lane.read_copy(sseq, binlen)
-                            with self._lock:
-                                self.shm_hub_copies += 1
-                            get_telemetry().inc("comm.shm_hub_copies",
-                                                reason="pin_pressure")
-                        else:
-                            # zero-copy: the routing queues hold
-                            # refcounted PINS into the slab — the
-                            # sender pool releases each entry's
-                            # reference on drain, and the reader's own
-                            # reference dies with this iteration
-                            region = st.lane.read(sseq, binlen)
-                            payload = region.view
-                    except ShmLaneError as e:
-                        logging.warning(
-                            "hub: shm lane error from node %s (%s) — "
-                            "dropping connection", node_id, e,
-                        )
+                        payload, region = self._laned_payload(
+                            st, node_id, binlen, sseq)
+                    except _HubConnError:
                         break
-                    with self._lock:
-                        self.shm_frames += 1
-                        self.shm_bytes += binlen
                 elif binlen:
                     payload = f.read(binlen)
                     if len(payload) < binlen:
@@ -645,66 +656,652 @@ class TcpHub:
         except OSError:
             pass  # peer vanished: fall through to cleanup
         finally:
+            with self._lock:
+                self._reader_count -= 1
             if st is not None:
-                lost: List[int] = []
-                lost_ranges = 0
-                with self._lock:
-                    st.dead = True
-                    # identity guard: a re-registered node may have
-                    # been rebound to a newer conn; deregister only the
-                    # ids still mapping HERE
-                    for nid in ids:
-                        if self._conns.get(nid) is st:
-                            self._conns.pop(nid, None)
-                            lost.append(nid)
-                    if st in self._range_conns:
-                        # a dying range conn takes its whole cohort
-                        # claim with it (displaced conns were already
-                        # removed at rebind time and don't reach here)
-                        self._range_conns.remove(st)
-                        lost_ranges = sum(
-                            hi - lo + 1 for lo, hi in st.ranges)
-                if lost_ranges and self._running:
-                    flight.note("events", "conn_death", cid=st.cid,
-                                mux=st.mux, node_ranges=list(st.ranges),
-                                n_nodes=lost_ranges)
-                    flight.trigger(
-                        "conn_death",
-                        reason=f"hub conn cid={st.cid} died; lost "
-                               f"range claim {list(st.ranges)} "
-                               f"({lost_ranges} node id(s))",
-                    )
-                if lost and self._running:
-                    # a live connection died while the hub is serving —
-                    # the black box dumps with the per-conn queue
-                    # gauges and hub_stats ring still warm.  A rebound
-                    # conn (ids already claimed elsewhere) is NOT a
-                    # death; ``lost`` is only the ids that went dark.
-                    flight.note("events", "conn_death", cid=st.cid,
-                                mux=st.mux, node_ids=sorted(lost)[:64],
-                                n_nodes=len(lost))
-                    flight.trigger(
-                        "conn_death",
-                        reason=f"hub conn cid={st.cid} died; lost "
-                               f"{len(lost)} node id(s) "
-                               f"{sorted(lost)[:8]}",
-                    )
-            if lane is not None:
-                # detach AND unlink: a gracefully-stopping dialer
-                # unlinks its own slab too (double unlink is a caught
-                # no-op), but a CRASHED dialer (os._exit) never will —
-                # without this, every peer crash leaks a segment in
-                # /dev/shm until reboot.  Mapped regions survive the
-                # unlink, so a reconnecting peer's fresh slab is
-                # unaffected.  To the peer this must look exactly like
-                # a dropped connection, and it does: doorbells stop,
-                # the socket closes, the reconnect path re-dials with
-                # a fresh slab.
+                self._conn_cleanup(st, ids)
+            elif lane is not None:
                 lane.close(unlink=True)
             try:
                 conn.close()
             except OSError:
                 pass
+
+    def _register_conn(self, st: _Conn, ids: List[int]) -> List["_Conn"]:
+        """Register a handshake-complete connection: apply the rebind
+        policy (new conn wins; range claims displace as atoms), install
+        the id/range routes, and return the fully-displaced stale conns
+        for the caller to dispose of — the threaded reader shuts their
+        sockets down (their own reader threads then clean up on EOF),
+        the reactor closes them inline through ``_close_conn_r``."""
+        rebound: List[int] = []
+        stale_conns: List[_Conn] = []
+        ranges = st.ranges
+        with self._lock:
+            st.cid = next(self._cids)
+            # range conns are DISPLACED AS ATOMS: any overlap with a
+            # new claim (id or range) kills the old conn's whole
+            # claim — an edge IS its cohort; there is no id-by-id
+            # partial rebind of a range.  Counted per covered id so
+            # the rebind series stays comparable across claim forms.
+            for rc in [c for c in self._range_conns if c is not st]:
+                hit = any(rc.covers(nid) for nid in ids) or any(
+                    lo <= rhi and rlo <= hi
+                    for lo, hi in ranges
+                    for rlo, rhi in rc.ranges)
+                if hit:
+                    self.node_rebinds += rc.claimed()
+                    rc.dead = True
+                    self._range_conns.remove(rc)
+                    stale_conns.append(rc)
+                    logging.warning(
+                        "hub: range claim %s displaces conn cid=%s "
+                        "(ranges %s) entirely — rebind",
+                        ranges or ids[:8], rc.cid, rc.ranges,
+                    )
+            if ranges:
+                # a new RANGE claim also steals any per-id claims it
+                # covers (same new-conn-wins policy; the node map is
+                # small wherever range claims happen — the root tier)
+                for nid, old in list(self._conns.items()):
+                    if old is not st and st.covers(nid):
+                        self.node_rebinds += 1
+                        rebound.append(nid)
+                        old.ids.discard(nid)
+                        del self._conns[nid]
+                        if not old.ids and not old.ranges:
+                            old.dead = True
+                            stale_conns.append(old)
+                self._range_conns.append(st)
+            for nid in ids:
+                old = self._conns.get(nid)
+                if old is not None and old is not st:
+                    # rebind policy (pinned): the NEW conn wins the
+                    # id; the old conn loses it and dies entirely
+                    # once it holds no ids — counted, never silent
+                    self.node_rebinds += 1
+                    rebound.append(nid)
+                    old.ids.discard(nid)
+                    if not old.ids:
+                        old.dead = True
+                        stale_conns.append(old)
+                self._conns[nid] = st
+        tel = get_telemetry()
+        for nid in rebound:
+            tel.inc("hub.node_rebinds")
+            logging.warning(
+                "hub: node %s re-registered on a new connection — "
+                "the old connection loses it (rebind)", nid,
+            )
+        return stale_conns
+
+    def _laned_payload(self, st: _Conn, node_id, binlen: int, sseq):
+        """Resolve one shm doorbell to its payload bytes: a refcounted
+        slab pin (``ShmRegion``) on the fast path, a counted
+        materialization under pin pressure.  Returns ``(payload,
+        region)``; raises ``_HubConnError`` for a lane-less doorbell or
+        a lane protocol error — connection-fatal, exactly like a
+        garbled header (the doorbell stream cannot resynchronize)."""
+        if st.lane is None:
+            logging.warning(
+                "hub: node %s sent an shm doorbell on a "
+                "lane-less connection — dropping it", node_id,
+            )
+            raise _HubConnError("doorbell on a lane-less connection")
+        region = None
+        try:
+            if st.lane.inbound_backlog() * 2 >= st.lane.nslots:
+                # pin-pressure valve: ring reclamation is in-order, so
+                # pins parked in one slow conn's send queue hold every
+                # LATER frame's bytes too.  With half the descriptor
+                # slots still pinned, materialize this frame (one
+                # copy, counted) instead of letting the writer's ring
+                # stall into inline-TCP fallbacks.
+                payload = st.lane.read_copy(sseq, binlen)
+                with self._lock:
+                    self.shm_hub_copies += 1
+                get_telemetry().inc("comm.shm_hub_copies",
+                                    reason="pin_pressure")
+            else:
+                # zero-copy: the routing queues hold refcounted PINS
+                # into the slab — the drain plane releases each
+                # entry's reference, and the reader's own reference
+                # dies right after routing
+                region = st.lane.read(sseq, binlen)
+                payload = region.view
+        except ShmLaneError as e:
+            logging.warning(
+                "hub: shm lane error from node %s (%s) — "
+                "dropping connection", node_id, e,
+            )
+            raise _HubConnError(str(e)) from None
+        with self._lock:
+            self.shm_frames += 1
+            self.shm_bytes += binlen
+        return payload, region
+
+    def _flush_conn_queues(self, st: _Conn) -> None:
+        """Release every queued entry of a connection that will never
+        drain again: count the drops, release each entry's payload
+        pin.  The one place queued refcounts die outside the drain
+        path — called on conn death (both planes) and at ``stop()``,
+        so a churn soak's pin count provably returns to zero.  (The
+        PR-13-era leak this closes: ``stop()`` exited the sender
+        workers by sentinel with pinned entries still queued.)"""
+        with self._lock:
+            leftovers = [(e[0], e[4], e[5]) for e in st.heads]
+            leftovers += [(e[0], e[4], e[5]) for e in st.frames]
+            st.heads.clear()
+            st.frames.clear()
+            st.nbytes = 0
+        for mt_, rids_, reg_ in leftovers:
+            for r in rids_ or ():
+                self._count_drop(r, mt_)
+            if reg_ is not None:
+                reg_.release()
+
+    def _conn_cleanup(self, st: _Conn, ids) -> None:
+        """Tear one connection down: deregister the ids/ranges still
+        mapping here, flush + release its queued entries, dump the
+        black box for a live death, and close the lane + socket.
+        Shared by the threaded reader's ``finally`` and the reactor's
+        ``_close_conn_r``."""
+        lost: List[int] = []
+        lost_ranges = 0
+        with self._lock:
+            st.dead = True
+            # identity guard: a re-registered node may have
+            # been rebound to a newer conn; deregister only the
+            # ids still mapping HERE
+            for nid in ids:
+                if self._conns.get(nid) is st:
+                    self._conns.pop(nid, None)
+                    lost.append(nid)
+            if st in self._range_conns:
+                # a dying range conn takes its whole cohort
+                # claim with it (displaced conns were already
+                # removed at rebind time and don't reach here)
+                self._range_conns.remove(st)
+                lost_ranges = sum(
+                    hi - lo + 1 for lo, hi in st.ranges)
+        # release queued pins NOW: in threaded mode a scheduled sender
+        # worker also clears dead conns (whoever pops under the lock
+        # wins — never a double release), but a conn dying between
+        # visits, or at stop(), must not strand its regions
+        self._flush_conn_queues(st)
+        if lost_ranges and self._running:
+            flight.note("events", "conn_death", cid=st.cid,
+                        mux=st.mux, node_ranges=list(st.ranges),
+                        n_nodes=lost_ranges)
+            flight.trigger(
+                "conn_death",
+                reason=f"hub conn cid={st.cid} died; lost "
+                       f"range claim {list(st.ranges)} "
+                       f"({lost_ranges} node id(s))",
+            )
+        if lost and self._running:
+            # a live connection died while the hub is serving —
+            # the black box dumps with the per-conn queue
+            # gauges and hub_stats ring still warm.  A rebound
+            # conn (ids already claimed elsewhere) is NOT a
+            # death; ``lost`` is only the ids that went dark.
+            flight.note("events", "conn_death", cid=st.cid,
+                        mux=st.mux, node_ids=sorted(lost)[:64],
+                        n_nodes=len(lost))
+            flight.trigger(
+                "conn_death",
+                reason=f"hub conn cid={st.cid} died; lost "
+                       f"{len(lost)} node id(s) "
+                       f"{sorted(lost)[:8]}",
+            )
+        if st.lane is not None:
+            # detach AND unlink: a gracefully-stopping dialer
+            # unlinks its own slab too (double unlink is a caught
+            # no-op), but a CRASHED dialer (os._exit) never will —
+            # without this, every peer crash leaks a segment in
+            # /dev/shm until reboot.  Mapped regions survive the
+            # unlink, so a reconnecting peer's fresh slab is
+            # unaffected.  To the peer this must look exactly like
+            # a dropped connection, and it does: doorbells stop,
+            # the socket closes, the reconnect path re-dials with
+            # a fresh slab.
+            st.lane.close(unlink=True)
+        try:
+            st.sock.close()
+        except OSError:
+            pass
+
+    # -- reactor data plane --------------------------------------------------
+    #
+    # Everything below runs on the ONE event-loop thread (plus _wake,
+    # which any thread may call).  Per-conn reactor fields (parser,
+    # phase, wpend, wcommit, wregion, want_write) are loop-thread-only
+    # by construction — the reactor's analogue of the single-drainer
+    # rule — while the routing/queue state stays under self._lock
+    # exactly as in threaded mode.
+
+    def _reactor_loop(self):
+        """The hub's event loop: one thread multiplexing accepts,
+        streaming reads (header/payload/doorbell), and writability-
+        driven queue drains for every connection — O(1) threads per
+        hub where the threaded plane burns one reader per conn."""
+        self._loop_tid = threading.get_ident()
+        tel = get_telemetry()
+        sel = self._sel
+        while self._running:
+            try:
+                events = sel.select(timeout=1.0)
+            except OSError:
+                break
+            t0 = time.perf_counter()
+            for key, mask in events:
+                data = key.data
+                if data is None:
+                    self._on_accept()
+                elif data == "wakeup":
+                    try:
+                        while os.read(self._wakeup_r, 4096):
+                            pass
+                    except (BlockingIOError, OSError):
+                        pass
+                else:
+                    if data.dead:
+                        continue
+                    if mask & selectors.EVENT_WRITE:
+                        # a parked conn's socket opened up: resume its
+                        # drain this batch (scheduled stays True while
+                        # parked, so _wake dedup keeps holding)
+                        with self._lock:
+                            self._drainq.append(data)
+                    if mask & selectors.EVENT_READ:
+                        self._on_readable(data)
+            self._drain_batch()
+            if events:
+                # loop lag: time this batch kept the loop away from
+                # select — the reactor's health metric (a stall here
+                # is queue wait for EVERY connection at once)
+                tel.observe("hub.loop_lag_s", time.perf_counter() - t0)
+        # shutdown: tear every conn down through the full cleanup path
+        # so queued pins are released, then drop the selector + pipe
+        for key in list(sel.get_map().values()):
+            if isinstance(key.data, _Conn):
+                self._close_conn_r(key.data)
+        try:
+            sel.close()
+        except OSError:
+            pass
+        for fd in (self._wakeup_r, self._wakeup_w):
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    def _on_accept(self):
+        """Accept every pending connection (edge-triggered burst):
+        non-blocking socket, a fresh streaming parser, EVENT_READ
+        registration — no thread spawn, which is what keeps per-conn
+        accept latency flat through a 512-connection churn storm."""
+        while True:
+            try:
+                conn, _ = self._srv.accept()
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                return
+            _tune_socket(conn)
+            conn.setblocking(False)
+            st = _Conn(conn)
+            st.parser = FrameParser(pool=self._bufpool,
+                                    scratch=self._rx_scratch)
+            try:
+                self._sel.register(conn, selectors.EVENT_READ, st)
+            except (ValueError, KeyError, OSError):
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _on_readable(self, st: _Conn):
+        """Service one conn's readability: recv into the parser's
+        target (scratch for headers, the pooled payload region's tail
+        once mid-payload), dispatch every completed frame.  Bounded to
+        ~16 recvs per event so a firehose conn can't starve the cohort
+        — the level-triggered select re-reports whatever is left."""
+        sock = st.sock
+        for _ in range(16):
+            try:
+                n = sock.recv_into(st.parser.recv_target())
+            except (BlockingIOError, InterruptedError):
+                return
+            except OSError:
+                self._close_conn_r(st)
+                return
+            if n == 0:
+                self._close_conn_r(st)  # EOF
+                return
+            try:
+                frames = st.parser.consumed(n)
+            except FrameError as e:
+                logging.warning(
+                    "hub: conn cid=%s dropped (%s)", st.cid, e)
+                self._close_conn_r(st)
+                return
+            for idx, (frame, line, payload, region) in enumerate(frames):
+                try:
+                    keep = self._on_frame(st, frame, line, payload,
+                                          region)
+                except _HubConnError:
+                    keep = False
+                except OSError:
+                    keep = False
+                except Exception:
+                    # never lose the LOOP to a routing bug — that
+                    # would be every connection wedged at once; the
+                    # faulting conn dies alone (threaded parity: an
+                    # unexpected error killed that reader thread)
+                    logging.exception(
+                        "hub: reactor error on conn cid=%s", st.cid)
+                    keep = False
+                if not keep:
+                    for _f, _l, _p, r in frames[idx + 1:]:
+                        if r is not None:
+                            r.release()
+                    self._close_conn_r(st)
+                    return
+
+    def _on_frame(self, st: _Conn, frame: dict, line: bytes, payload,
+                  region) -> bool:
+        """Handle ONE parsed frame: the handshake state machine
+        (hello → clock-sync → registered), then the routing dispatch
+        shared with the threaded reader.  Returns False when the
+        connection must close.  Owns ``region`` (the parser handed the
+        reader's reference over) and releases it before returning."""
+        if st.phase == 0:
+            if region is not None:
+                region.release()  # a hello never carries a payload
+            ids, ranges, mux, nid0 = self._parse_hello(frame)
+            st.ids = set(ids)
+            st.ranges = tuple((int(lo), int(hi)) for lo, hi in ranges)
+            st.mux = mux
+            st.nid0 = nid0
+            st.lane = self._attach_lane(frame, nid0)
+            # ACK BEFORE registering (same contract as the threaded
+            # plane: nothing can be routed here until registration, so
+            # the queued ACK is the next bytes the dialer reads)
+            self._ctrl_reply(st, {**_ACK, "shm": st.lane is not None})
+            st.phase = 1
+            return True
+        if st.phase == 1:
+            if region is not None:
+                region.release()
+            kind = frame.get(HUB_KEY)
+            if kind == "ping":
+                # still unregistered: the queued pong is guaranteed to
+                # be the dialer's next line — RTT stays pure wire +
+                # one loop batch
+                self._ctrl_reply(st, {
+                    HUB_KEY: "pong",
+                    "t0": frame.get("t0"),
+                    "th": time.perf_counter(),
+                })
+                return True
+            if kind == "ping_done":
+                self._finish_register_r(st)
+                return True
+            # pre-handshake peers (an old dialer): register now and
+            # fall through to route this very frame
+            self._finish_register_r(st)
+        binlen = frame.get(FRAME_BINLEN_KEY)
+        sseq = frame.pop(SHM_SEQ_KEY, None)
+        if binlen and sseq is not None:
+            payload, region = self._laned_payload(
+                st, st.nid0, binlen, sseq)
+        try:
+            return self._route_frame(st, st.nid0, frame, line,
+                                     payload, sseq, region)
+        finally:
+            if region is not None:
+                region.release()
+
+    def _finish_register_r(self, st: _Conn) -> None:
+        """Registration, reactor side: install the routes (shared
+        rebind policy) and dispose of the displaced conns inline —
+        there is no per-conn reader thread to notice their EOF."""
+        stale = self._register_conn(st, sorted(st.ids))
+        for old in stale:
+            self._close_conn_r(old)
+        st.phase = 2
+
+    def _ctrl_reply(self, st: _Conn, obj: dict) -> None:
+        """Queue a handshake control line (ACK, pong) on the conn's own
+        frame queue: before registration nothing else can enqueue to
+        it, so the reply is the next bytes the dialer reads — the
+        threaded plane's direct-write guarantee, in queue form."""
+        data = (json.dumps(obj) + "\n").encode()
+        with self._lock:
+            if st.dead:
+                return
+            st.frames.append((None, (data,), None, len(data), (), None))
+            st.nbytes += len(data)
+            if not st.scheduled:
+                st.scheduled = True
+                self._drainq.append(st)
+
+    def _drain_batch(self):
+        """Drain every conn the batch scheduled.  Head-start contract,
+        reactor form: a strict-priority first pass drains queued heads
+        (stripe 0s) across ALL conns before any conn's tail pass — so
+        every receiver starts streaming within one batch — then tails
+        drain to each socket until it would block; the kernel's socket
+        buffer is the pacing, and a blocked conn parks on EVENT_WRITE
+        instead of holding a worker."""
+        with self._lock:
+            if not self._drainq:
+                return
+            batch = list(dict.fromkeys(self._drainq))
+            self._drainq.clear()
+        for st in batch:
+            self._drain_conn(st, heads_only=True)
+        for st in batch:
+            self._drain_conn(st, heads_only=False)
+
+    def _drain_conn(self, st: _Conn, heads_only: bool = False) -> None:
+        """Drain one conn's queues onto its non-blocking socket until
+        empty (unschedule + unpark) or the socket would block (park on
+        EVENT_WRITE).  Frame bytes are built by the SAME helpers as
+        the threaded sender pool (``_entry_wire``/``_prepare_send``),
+        so the two planes are byte-identical by construction."""
+        if st.dead:
+            return
+        while True:
+            if st.wpend and not self._flush_wpend(st):
+                return  # parked (or died) mid-frame
+            stale_rids = False
+            live_nodes = None
+            stale_subset: Tuple = ()
+            popped = False
+            with self._lock:
+                if st.dead:
+                    return
+                if st.heads:
+                    msg_type, parts, hdr, nbytes, rids, region = \
+                        st.heads.popleft()
+                    st.nbytes -= nbytes
+                    popped = True
+                elif not heads_only and st.frames:
+                    msg_type, parts, hdr, nbytes, rids, region = \
+                        st.frames.popleft()
+                    st.nbytes -= nbytes
+                    popped = True
+                elif not heads_only:
+                    # fully drained: give the conn up (a later
+                    # _forward re-schedules it) and stop watching
+                    # writability, or the level-triggered select
+                    # would spin on the always-writable socket
+                    st.scheduled = False
+                if popped:
+                    stale_rids, live_nodes, stale_subset = \
+                        self._stale_check_locked(st, rids, hdr)
+            if not popped:
+                if not heads_only:
+                    self._unpark(st)
+                return
+            if stale_rids:
+                # every id this entry addressed was rebound away
+                if region is not None:
+                    region.release()
+                for r in rids:
+                    self._count_drop(r, msg_type)
+                continue
+            if live_nodes is not None:
+                # partially-rebound mux copy: the stolen ids lose
+                # this frame (counted), the live ones still get it
+                for r in stale_subset:
+                    self._count_drop(r, msg_type)
+            try:
+                hdr_dict, line, body = self._entry_wire(
+                    parts, hdr, live_nodes)
+                out_parts, commit = self._prepare_send(
+                    st, hdr_dict, line, body)
+            except Exception:
+                # never lose the loop to a drain bug: the frame dies
+                # (counted), the conn keeps draining — threaded-plane
+                # parity with the sender worker's catch-all
+                logging.exception("hub: reactor drain error for "
+                                  "conn cid=%s", st.cid)
+                if region is not None:
+                    region.release()
+                self._count_drop(rids[0] if rids else -1, msg_type)
+                continue
+            pend = [p if isinstance(p, memoryview) else memoryview(p)
+                    for p in out_parts]
+            st.wpend = [v if v.format == "B" and v.ndim == 1
+                        else v.cast("B") for v in pend]
+            st.wcommit = commit
+            st.wregion = region
+            st.wmsg_type = msg_type
+            # loop: the flush at the top writes it out
+
+    def _flush_wpend(self, st: _Conn) -> bool:
+        """Write the in-flight frame's remaining bytes.  True when the
+        frame fully flushed (lane doorbell committed, payload pin
+        released); False when the socket would block (conn parked on
+        EVENT_WRITE) or died (torn down inline)."""
+        sock = st.sock
+        pend = st.wpend
+        try:
+            while pend:
+                if hasattr(sock, "sendmsg"):
+                    sent = sock.sendmsg(pend[:_IOV_MAX])
+                else:  # non-POSIX fallback
+                    sent = sock.send(pend[0])
+                while sent:
+                    head = pend[0]
+                    if sent >= len(head):
+                        sent -= len(head)
+                        pend.pop(0)
+                    else:
+                        pend[0] = head[sent:]
+                        sent = 0
+        except (BlockingIOError, InterruptedError):
+            st.wpend = pend
+            self._park(st)
+            return False
+        except OSError:
+            # dead receiver: count the in-flight frame, tear the conn
+            # down (cleanup flushes + releases everything queued) —
+            # the sender pool's OSError contract, reactor form
+            self._count_drop(st.nid0 if st.nid0 is not None else -1,
+                             st.wmsg_type)
+            self._close_conn_r(st)
+            return False
+        st.wpend = []
+        if st.wcommit is not None:
+            # doorbell fully on the socket AFTER the payload was fully
+            # in the slab: commit announces the descriptor (a writer
+            # killed in between leaves nothing deliverable)
+            lane, pending, nbody = st.wcommit
+            st.wcommit = None
+            lane.commit(pending)
+            with self._lock:
+                self.shm_frames += 1
+                self.shm_bytes += nbody
+        if st.wregion is not None:
+            # sent: this entry's pin dies here — when the LAST queue's
+            # copy drains, the region's bytes are reclaimed
+            st.wregion.release()
+            st.wregion = None
+        st.wmsg_type = None
+        return True
+
+    def _park(self, st: _Conn) -> None:
+        if st.want_write or st.dead:
+            return
+        try:
+            self._sel.modify(st.sock,
+                             selectors.EVENT_READ | selectors.EVENT_WRITE,
+                             st)
+            st.want_write = True
+        except (KeyError, ValueError, OSError):
+            pass
+
+    def _unpark(self, st: _Conn) -> None:
+        if not st.want_write:
+            return
+        try:
+            self._sel.modify(st.sock, selectors.EVENT_READ, st)
+        except (KeyError, ValueError, OSError):
+            pass
+        st.want_write = False
+
+    def _close_conn_r(self, st: _Conn) -> None:
+        """Reactor-side conn teardown: selector deregistration, the
+        parser's in-progress region, the in-flight frame's pin, then
+        the shared cleanup (deregister ids, flush queued pins, flight
+        triggers, lane + socket close).  Idempotent — displacement and
+        a same-batch EOF may both reach here."""
+        if st.parser is None and st.dead:
+            return  # already torn down
+        try:
+            self._sel.unregister(st.sock)
+        except (KeyError, ValueError, OSError):
+            pass
+        if st.parser is not None:
+            st.parser.close()
+            st.parser = None
+        if st.wregion is not None:
+            st.wregion.release()
+            st.wregion = None
+        st.wcommit = None
+        st.wpend = []
+        st.want_write = False
+        self._conn_cleanup(st, list(st.ids))
+
+    def _wake(self, st: _Conn, receiver) -> None:
+        """Hand a newly-scheduled conn to its drain plane: the sender
+        pool's ready queue (threaded), or the loop's drain queue plus
+        — when called OFF the loop thread — a wakeup-pipe tickle so a
+        sleeping select services it now.  That pipe is the reactor's
+        one cross-thread entry point: stop() uses it too, and chaos
+        timer-delayed deliveries need nothing special (they arrive as
+        ordinary socket readability on the injecting backend's conn)."""
+        if self._mode == "threaded":
+            self._ready.put((receiver, st))
+            return
+        with self._lock:
+            self._drainq.append(st)
+        if threading.get_ident() != self._loop_tid:
+            self._tickle()
+
+    def _tickle(self) -> None:
+        try:
+            os.write(self._wakeup_w, b"\x00")
+        except (BlockingIOError, OSError):
+            pass  # pipe full: a wakeup is already pending
 
     def _route_frame(self, st: _Conn, node_id: int, frame: dict,
                      line: bytes, payload, sseq, region) -> bool:
@@ -977,7 +1574,7 @@ class TcpHub:
             get_telemetry().inc("hub.zero_copy_forwards",
                                 msg_type=msg_type or "?")
         if wake:
-            self._ready.put((receiver, st))
+            self._wake(st, receiver)
         return True
 
     def _fan_out_striped(self, frame: dict, groups, mt,
@@ -1119,7 +1716,7 @@ class TcpHub:
                 self._count_drop(r, msg_type)
             return
         if wake:
-            self._ready.put((receiver, st))
+            self._wake(st, receiver)
 
     def _sender_loop(self):
         """Sender-pool worker: drain the one connection handed to it.
@@ -1181,30 +1778,8 @@ class TcpHub:
                             st.frames.popleft()
                         st.nbytes -= nbytes
                     if not requeue and dead_leftovers is None:
-                        # rebind re-check: any id this entry targets
-                        # may have been claimed by a NEWER connection
-                        # while the frame sat queued — the displaced
-                        # owner must not deliver to it (straggler
-                        # drop, exactly the policy's "old conn loses
-                        # it").  Deferred mux/stripe-0 entries carry
-                        # their target list in ``meta['nodes']`` and
-                        # get it FILTERED to the live subset (the
-                        # outer header is rebuilt at drain anyway);
-                        # whole entries drop only when every target is
-                        # gone.  Range-claim conns are exempt: their
-                        # cohort is an atom (displacement kills the
-                        # whole conn via ``st.dead`` above, never a
-                        # single id), so ``st.ids`` being empty must
-                        # not read as "everything stale".
-                        if rids and not st.ranges:
-                            stale_subset = tuple(
-                                r for r in rids if r not in st.ids)
-                            if len(stale_subset) == len(rids):
-                                stale_rids = True
-                            elif stale_subset and isinstance(hdr, tuple) \
-                                    and hdr[1].get("nodes"):
-                                live_nodes = [r for r in rids
-                                              if r in st.ids]
+                        stale_rids, live_nodes, stale_subset = \
+                            self._stale_check_locked(st, rids, hdr)
                 if dead_leftovers is not None:
                     for mt_, rids_, reg_ in dead_leftovers:
                         for r in rids_ or ():
@@ -1233,68 +1808,9 @@ class TcpHub:
                     for r in stale_subset:
                         self._count_drop(r, msg_type)
                 try:
-                    if isinstance(hdr, tuple):
-                        # deferred copy: build the outer header at
-                        # drain time — around the hub_out-restamped
-                        # inner header line when traced (inner_hdr set),
-                        # around the raw body otherwise.  Two kinds:
-                        # stripe 0 of a striped mcast and a mux wrap.
-                        # ``live_nodes`` (rebind filtering) replaces
-                        # the meta's target list when set.
-                        kind, meta, inner_hdr = hdr
-                        if live_nodes is not None:
-                            meta = {**meta, "nodes": live_nodes}
-                        if inner_hdr is not None:
-                            line = trace_ctx.hub_out_line(inner_hdr)
-                            body = [line, *parts]
-                        else:
-                            line = None
-                            body = list(parts)
-                        if kind == MUX_KIND:
-                            out_hdr = {HUB_KEY: MUX_KIND, **meta,
-                                       FRAME_BINLEN_KEY: sum(
-                                           len(p) for p in body)}
-                        else:
-                            out_hdr = {HUB_KEY: MCAST_STRIPE_KIND,
-                                       **meta}
-                            if line is not None:
-                                # traced stripe 0: the restamped line
-                                # IS the chunk — crc what actually ships
-                                out_hdr["crc"] = zlib.crc32(line)
-                            out_hdr[FRAME_BINLEN_KEY] = sum(
-                                len(p) for p in body)
-                        self._conn_send(st, out_hdr, None, body, msg_type)
-                    elif hdr is not None:
-                        # traced frame: re-encode the (small) header
-                        # line with THIS copy's hub_out stamp at drain
-                        # time — hub_out - hub_in is this receiver's
-                        # real queue wait; the payload tail stays the
-                        # one shared immutable object
-                        stamped = dict(hdr)
-                        trace_ctx.hub_stamp(stamped, "hub_out")
-                        self._conn_send(st, stamped, None, list(parts),
-                                        msg_type)
-                    else:
-                        # untraced complete frame(s): split the header
-                        # line off the first part so the payload tail
-                        # is lane-eligible (a scan up to the first
-                        # newline, never a payload copy)
-                        first = parts[0]
-                        end = split_frame_line(first)
-                        if end <= 0 or end == len(first):
-                            # header-only first part (control frames,
-                            # the unicast-forward (line, payload) shape)
-                            body = [p for p in parts[1:] if len(p)]
-                            self._conn_send(st, None, first, body,
-                                            msg_type)
-                        else:
-                            # embedded header (whole-frame mcast copy):
-                            # the tail view shares the one payload object
-                            view = memoryview(first)
-                            body = [view[end:],
-                                    *(p for p in parts[1:] if len(p))]
-                            self._conn_send(st, None, bytes(view[:end]),
-                                            body, msg_type)
+                    hdr_dict, line, body = self._entry_wire(
+                        parts, hdr, live_nodes)
+                    self._conn_send(st, hdr_dict, line, body, msg_type)
                 except OSError:
                     # dead receiver: count this frame + everything still
                     # queued, deregister (its reader thread finishes
@@ -1338,16 +1854,108 @@ class TcpHub:
                     # LAST queue's copy drains, the ring reclaims
                     region.release()
 
-    def _conn_send(self, st: _Conn, hdr_dict, line, body, msg_type) -> None:
-        """Write one frame to a connection: header line on the socket,
-        payload either vectored behind it (TCP) or through the conn's
-        shm ring with a doorbell key in the header (lane).  Exactly one
-        of ``hdr_dict`` (still a dict — drain-built outer headers,
-        traced restamps) and ``line`` (already-encoded bytes) is set;
+    def _stale_check_locked(self, st: _Conn, rids,
+                            hdr):  # fedlint: holds=_lock
+        """Drain-time rebind re-check, shared by both drain planes: any
+        id a queued entry targets may have been claimed by a NEWER
+        connection while the frame sat queued — the displaced owner
+        must not deliver to it (straggler drop, exactly the policy's
+        "old conn loses it").  Deferred mux/stripe-0 entries carry
+        their target list in ``meta['nodes']`` and get it FILTERED to
+        the live subset (the outer header is rebuilt at drain anyway);
+        whole entries drop only when every target is gone.  Range-claim
+        conns are exempt: their cohort is an atom (displacement kills
+        the whole conn via ``st.dead``, never a single id), so
+        ``st.ids`` being empty must not read as "everything stale".
+        Returns ``(stale_rids, live_nodes, stale_subset)``."""
+        assert_held(self._lock, "TcpHub._stale_check_locked")
+        if not rids or st.ranges:
+            return False, None, ()
+        stale_subset = tuple(r for r in rids if r not in st.ids)
+        if len(stale_subset) == len(rids):
+            return True, None, stale_subset
+        live_nodes = None
+        if stale_subset and isinstance(hdr, tuple) \
+                and hdr[1].get("nodes"):
+            live_nodes = [r for r in rids if r in st.ids]
+        return False, live_nodes, stale_subset
+
+    @staticmethod
+    def _entry_wire(parts, hdr, live_nodes):
+        """Build ONE queue entry's wire form — a byte-identity
+        chokepoint shared by both drain planes (the sender pool and the
+        reactor build identical frames by construction).  Three entry
+        shapes:
+
+        - deferred tuple ``(kind, meta, inner_hdr)``: build the outer
+          header at drain time — around the hub_out-restamped inner
+          header line when traced (``inner_hdr`` set), around the raw
+          body otherwise.  Two kinds: stripe 0 of a striped mcast and
+          a mux wrap.  ``live_nodes`` (rebind filtering) replaces the
+          meta's target list when set.
+        - parsed dict: a traced frame — re-encode the (small) header
+          line with THIS copy's hub_out stamp at drain time (hub_out -
+          hub_in is this receiver's real queue wait); the payload tail
+          stays the one shared immutable object.
+        - ``None``: untraced complete frame(s) — split the header line
+          off the first part so the payload tail is lane-eligible (a
+          scan up to the first newline, never a payload copy).
+
+        Returns ``(hdr_dict, line, body)`` for ``_prepare_send``:
+        exactly one of ``hdr_dict``/``line`` is set."""
+        if isinstance(hdr, tuple):
+            kind, meta, inner_hdr = hdr
+            if live_nodes is not None:
+                meta = {**meta, "nodes": live_nodes}
+            if inner_hdr is not None:
+                line = trace_ctx.hub_out_line(inner_hdr)
+                body = [line, *parts]
+            else:
+                line = None
+                body = list(parts)
+            if kind == MUX_KIND:
+                out_hdr = {HUB_KEY: MUX_KIND, **meta,
+                           FRAME_BINLEN_KEY: sum(len(p) for p in body)}
+            else:
+                out_hdr = {HUB_KEY: MCAST_STRIPE_KIND, **meta}
+                if line is not None:
+                    # traced stripe 0: the restamped line IS the
+                    # chunk — crc what actually ships
+                    out_hdr["crc"] = zlib.crc32(line)
+                out_hdr[FRAME_BINLEN_KEY] = sum(len(p) for p in body)
+            return out_hdr, None, body
+        if hdr is not None:
+            stamped = dict(hdr)
+            trace_ctx.hub_stamp(stamped, "hub_out")
+            return stamped, None, list(parts)
+        first = parts[0]
+        end = split_frame_line(first)
+        if end <= 0 or end == len(first):
+            # header-only first part (control frames, the
+            # unicast-forward (line, payload) shape)
+            return None, first, [p for p in parts[1:] if len(p)]
+        # embedded header (whole-frame mcast copy): the tail view
+        # shares the one payload object
+        view = memoryview(first)
+        return (None, bytes(view[:end]),
+                [view[end:], *(p for p in parts[1:] if len(p))])
+
+    def _prepare_send(self, st: _Conn, hdr_dict, line, body):
+        """Lane-or-inline decision + final header encoding for one
+        outbound frame — the second shared chokepoint: both drain
+        planes ship exactly what this returns.  Exactly one of
+        ``hdr_dict`` (still a dict — drain-built outer headers, traced
+        restamps) and ``line`` (already-encoded bytes) is set on entry;
         ``body`` holds the payload parts.  Lane refusal (ring full,
         descriptor queue full, oversized) falls back to the inline
         write, per frame, counted — never an error and never a stall.
-        OSErrors propagate to the caller's dead-receiver handling."""
+
+        Returns ``(socket_parts, lane_commit)``: ``lane_commit`` is
+        ``(lane, pending, nbody)`` when the payload rode the shm ring —
+        the caller commits it only AFTER the doorbell line is fully on
+        the socket (a writer killed between the two leaves nothing
+        deliverable; the descriptor is never announced) and then counts
+        ``shm_frames``/``shm_bytes``."""
         lane = st.lane
         nbody = sum(len(p) for p in body) if body else 0
         if lane is not None and nbody >= self._shm_min and nbody:
@@ -1358,22 +1966,27 @@ class TcpHub:
                 out = (json.dumps(
                     {**hdr_dict, SHM_SEQ_KEY: ShmLane.seq_of(pending)}
                 ) + "\n").encode()
-                # doorbell AFTER the payload is fully in the slab: a
-                # writer killed between the two leaves nothing
-                # deliverable (the descriptor is never announced)
-                _sendall_parts(st.sock, [out])
-                lane.commit(pending)
-                with self._lock:
-                    self.shm_frames += 1
-                    self.shm_bytes += nbody
-                return
+                return [out], (lane, pending, nbody)
             with self._lock:
                 self.shm_fallbacks += 1
             get_telemetry().inc("comm.shm_fallbacks",
                                 reason=lane.last_refusal)
         if hdr_dict is not None:
             line = (json.dumps(hdr_dict) + "\n").encode()
-        _sendall_parts(st.sock, [line, *body] if body else [line])
+        return ([line, *body] if body else [line]), None
+
+    def _conn_send(self, st: _Conn, hdr_dict, line, body, msg_type) -> None:
+        """Threaded-plane frame write: blocking vectored send of
+        whatever ``_prepare_send`` decided, then the lane commit.
+        OSErrors propagate to the caller's dead-receiver handling."""
+        parts, commit = self._prepare_send(st, hdr_dict, line, body)
+        _sendall_parts(st.sock, parts)
+        if commit is not None:
+            lane, pending, nbody = commit
+            lane.commit(pending)
+            with self._lock:
+                self.shm_frames += 1
+                self.shm_bytes += nbody
 
     def _count_drop(self, receiver: int, msg_type) -> None:
         mt = msg_type or HUB_KEY
@@ -1417,7 +2030,36 @@ class TcpHub:
                  if c.lane is not None}
             )
             snap["range_conns"] = len(self._range_conns)
+            snap["mode"] = self._mode
+            snap["threads"] = self._thread_count_locked()
+            snap["open_fds"] = self._open_fds_locked()
         return snap
+
+    def _thread_count_locked(self) -> int:  # fedlint: holds=_lock
+        """Hub-owned thread count — the reactor's O(1)-in-connections
+        claim as a measurement: 1 loop thread, vs the threaded plane's
+        accept thread + sender pool + one reader per live conn."""
+        assert_held(self._lock, "TcpHub._thread_count_locked")
+        if self._mode == "reactor":
+            return 1
+        return 1 + len(self._senders) + self._reader_count
+
+    def _open_fds_locked(self) -> int:  # fedlint: holds=_lock
+        """Descriptors the hub holds open (reactor: everything the
+        selector watches — server + wakeup pipe + conns; threaded:
+        conn sockets + the server)."""
+        assert_held(self._lock, "TcpHub._open_fds_locked")
+        if self._mode == "reactor":
+            try:
+                fd_map = self._sel.get_map()
+            except RuntimeError:
+                fd_map = None
+            # a closed selector returns None (3.11) or raises — either
+            # way stop() raced the sample and the honest answer is 0
+            return len(fd_map) if fd_map is not None else 0
+        conns = set(map(id, self._conns.values()))
+        conns.update(map(id, self._range_conns))
+        return len(conns) + 1
 
     def sample_telemetry(self, telemetry=None) -> dict:
         """Snapshot ``stats()`` + per-CONNECTION send-queue depths into
@@ -1446,6 +2088,8 @@ class TcpHub:
                 if st.lane is not None:
                     shm_conns += 1
             snap = self._counters_snapshot()
+            hub_threads = self._thread_count_locked()
+            open_fds = self._open_fds_locked()
         for cid, (nframes, nbytes, nids) in depths.items():
             t.gauge_set("hub.send_queue_frames", nframes, conn=cid)
             t.gauge_set("hub.send_queue_bytes", nbytes, conn=cid)
@@ -1466,6 +2110,11 @@ class TcpHub:
         t.gauge_set("hub.shm_frames_total", snap["shm_frames"])
         t.gauge_set("hub.shm_bytes_total", snap["shm_bytes"])
         t.gauge_set("hub.shm_fallbacks_total", snap["shm_fallbacks"])
+        # reactor-plane health: thread inventory (the O(1) claim as a
+        # series) and watched-descriptor count; hub.loop_lag_s (the
+        # per-batch histogram) is observed by the loop itself
+        t.gauge_set("hub.threads", hub_threads)
+        t.gauge_set("hub.open_fds", open_fds)
         t.event(
             "hub_stats", t_m=trace_ctx.now(),
             connections=sorted(depths),
@@ -1481,8 +2130,30 @@ class TcpHub:
 
     def stop(self):
         self._running = False
+        if self._mode == "reactor":
+            # wake the sleeping select; the loop's exit path tears every
+            # conn down through _close_conn_r (pins flushed + released)
+            self._tickle()
+            self._loop_thread.join(timeout=10)
+            if self._loop_thread.is_alive():
+                # loop wedged (should not happen): at least release the
+                # queued pins so a soak's leak accounting stays clean
+                with self._lock:
+                    states = (set(self._conns.values())
+                              | set(self._range_conns))
+                for st in states:
+                    self._flush_conn_queues(st)
+                    try:
+                        st.sock.close()
+                    except OSError:
+                        pass
+                try:
+                    self._srv.close()
+                except OSError:
+                    pass
+            return
         with self._lock:
-            states = list(self._conns.values())
+            states = set(self._conns.values()) | set(self._range_conns)
         for st in states:
             try:
                 st.sock.close()
@@ -1490,6 +2161,12 @@ class TcpHub:
                 pass
         for _ in self._senders:
             self._ready.put(None)
+        # the workers exit via the sentinel WITHOUT visiting their
+        # queues: release every still-queued payload pin here, or a
+        # stop with in-flight laned frames leaks their slab/pool
+        # references (the dead-receiver cleanup satellite's stop() leg)
+        for st in states:
+            self._flush_conn_queues(st)
         self._srv.close()
 
 

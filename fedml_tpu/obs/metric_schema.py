@@ -100,6 +100,8 @@ GAUGES = {
     "hub.shm_fallbacks_total": "cumulative hub-side lane fallbacks to inline TCP",
     "hub.mcast_frames_total": "cumulative mcast frames (time series form)",
     "hub.stripe_frames_total": "cumulative enqueued mcast stripes (time series form)",
+    "hub.threads": "hub-owned OS threads (reactor: 1; threaded: accept + senders + readers)",
+    "hub.open_fds": "descriptors the hub holds open (reactor: selector map size)",
     "jax.device_mem_bytes": "device memory in use {device=}",
     "jax.device_mem_peak_bytes": "high-water device memory {device=}",
     "digest.streams": "distinct digest source streams the rollup has seen",
@@ -130,6 +132,7 @@ HISTOGRAMS = {
     "jax.backend_compile_s": "runtime-reported compile durations {event=}",
     "flight.dump_write_s": "atomic flight-bundle write (snapshot + json + replace)",
     "lock.wait_s": "CheckedLock acquire block time past the flight threshold {lock=}",
+    "hub.loop_lag_s": "reactor event-loop batch service time (time away from select)",
 }
 
 # --- dynamic-name patterns ---------------------------------------------------

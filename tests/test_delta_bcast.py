@@ -268,9 +268,17 @@ def test_resync_recovery_preserves_chain_byte_identity():
                 b.stop()
             hub.stop()
 
-    before = _counters()
-    wiped = run(amnesia=True)
-    after = _counters()
+    # the wipe races the federation from the test thread: on a starved
+    # box all 5 rounds can finish before clear() lands, so the run
+    # triggers no resync — retry the setup (bounded), the identity
+    # assertion itself is unconditional
+    for _attempt in range(3):
+        before = _counters()
+        wiped = run(amnesia=True)
+        after = _counters()
+        if after.get("comm.delta_resyncs", 0) \
+                > before.get("comm.delta_resyncs", 0):
+            break
     assert after.get("comm.delta_resyncs", 0) \
         > before.get("comm.delta_resyncs", 0), "amnesia never triggered"
     clean = run(amnesia=False)

@@ -331,6 +331,42 @@ def test_forensics_shm_ring_full_and_straggler_and_none(tmp_path):
     assert fed_forensics.analyze(str(tmp_path))["fault_kind"] == "none"
 
 
+def test_forensics_lock_contention_channel(tmp_path):
+    """PR-16's lock-wait ring finally feeds a verdict: real recorded
+    blocking (CheckedLock tap rows) with nothing else anomalous yields
+    a low-confidence lock_contention verdict naming the hottest lock;
+    below the wait thresholds it stays "none"; and a crash on record
+    SHADOWS it (contention explains latency, it is not the fault)."""
+    locks = [{"t_m": 1000.5, "lock": "round_lock", "wait_s": 0.03},
+             {"t_m": 1001.0, "lock": "round_lock", "wait_s": 0.04},
+             {"t_m": 1001.5, "lock": "TcpHub._lock", "wait_s": 0.0}]
+    _write_bundle(tmp_path, "node0",
+                  rings={"events": _server_rounds(), "locks": locks})
+    v = fed_forensics.analyze(str(tmp_path))
+    assert v["fault_kind"] == "lock_contention"
+    assert v["confidence"] == "low"
+    hot = [e for e in v["evidence"] if e["kind"] == "lock_wait"]
+    assert hot and hot[0]["lock"] == "round_lock"
+    assert hot[0]["contended"] == 2
+    assert any(e["kind"] == "hottest_lock" and e["lock"] == "round_lock"
+               for e in v["evidence"])
+    # the ranked report is present regardless of the verdict
+    assert v["lock_contention"][0]["lock"] == "round_lock"
+    # sub-threshold waits (< 50 ms total, < 20 ms max) do not verdict
+    tiny = [{"t_m": 1000.5, "lock": "round_lock", "wait_s": 0.001}]
+    _write_bundle(tmp_path, "node0",
+                  rings={"events": _server_rounds(), "locks": tiny})
+    assert fed_forensics.analyze(str(tmp_path))["fault_kind"] == "none"
+    # a crash outranks contention: the stall is evidence, not the fault
+    _write_bundle(tmp_path, "node0",
+                  rings={"events": _server_rounds(), "locks": locks})
+    _write_bundle(tmp_path, "node2", history=[
+        {"kind": "crash", "reason": "crash_at_round", "round": 1,
+         "t_m": 1002.5, "t_wall": 1002.5}])
+    v = fed_forensics.analyze(str(tmp_path))
+    assert v["fault_kind"] == "client_crash"
+
+
 def test_forensics_round_diff_flags_the_anomalous_round(tmp_path):
     spans = [{"t_m": 1000.5, "kind": "span.decode_wait_s", "v": 0.01},
              {"t_m": 1003.0, "kind": "span.decode_wait_s", "v": 0.50}]

@@ -230,7 +230,10 @@ def test_rebind_kills_already_queued_frames_for_stolen_id(monkeypatch):
     """The rebind policy holds for IN-FLIGHT frames too: a frame queued
     on the old connection for an id that is rebound while it waits is
     dropped at drain (counted), never delivered to the displaced
-    owner."""
+    owner.  Pinned to the THREADED plane: the gate below blocks a
+    sender-pool worker mid-write, which only exists there (the reactor
+    counterpart — would-block park + rebind — lives in
+    test_reactor.py)."""
     import threading
 
     from fedml_tpu.comm import tcp as tcp_mod
@@ -238,7 +241,7 @@ def test_rebind_kills_already_queued_frames_for_stolen_id(monkeypatch):
     gate = threading.Event()
     real_sendall = tcp_mod._sendall_parts
     blocked_once = threading.Event()
-    hub = TcpHub(senders=1)
+    hub = TcpHub(senders=1, mode="threaded")
 
     def gated_sendall(sock, parts):
         # block the hub's (single) sender worker on the FIRST test

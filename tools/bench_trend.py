@@ -19,9 +19,11 @@ JSON form on every run as a downloadable trajectory artifact.
 family's artifact from the prior round, within a per-family tolerance
 (``GATE_RULES``): latency/overhead metrics must not grow past it,
 accuracy/survival metrics must not shrink past it, ok-booleans must
-not flip false.  Nonzero exit on any regression — CI runs it warn-only
-(the artifacts are committed measurements, not re-runs; a flagged
-regression is a review prompt, not a build breaker).
+not flip false.  Exit 1 on any warn-only regression — committed
+measurements from dev machines are review prompts, not build breakers
+— but a flipped ok/digest-pin boolean in a correctness family
+(``ENFORCED_FAMILIES``: byte-identity pins, not timings) exits 2 and
+CI fails the build on it.
 
 Stdlib-only (runs in the CI lint job's bare interpreter).
 """
@@ -39,7 +41,7 @@ PREFIXES = (
     "BENCH_", "FEDLAT_", "FEDSCALE_", "FEDTRACE_", "FEDHEALTH_",
     "FAULTS_", "CONVERGENCE_", "COMPRESS_", "MULTICHIP_", "SCALING_",
     "FEDERATION_", "ROBUST_", "FEDXPORT_", "FEDCHURN_", "FEDFLIGHT_",
-    "FEDTREE_", "FEDBUFF_", "FEDTRAFFIC_", "FEDSHARD_",
+    "FEDTREE_", "FEDBUFF_", "FEDTRAFFIC_", "FEDSHARD_", "FEDHUB_",
 )
 
 _ROUND_RE = re.compile(r"[_-]r(\d+)")
@@ -259,6 +261,26 @@ def _extract(doc: dict, fname: str) -> dict:
             out["fedllm_sharded_leaves"] = v
         if doc.get("ok") is not None:
             out["ok"] = bool(doc["ok"])
+    elif fname.startswith("FEDHUB_"):
+        for k in ("pins", "threads", "churn", "round_wall", "zero_copy",
+                  "chaos"):
+            ok = _deep_get(doc, f"{k}.ok")
+            if ok is not None:
+                out[f"ok[{k}]"] = bool(ok)
+        v = _num(_deep_get(doc, "threads.reactor_threads_512"))
+        if v is not None:
+            out["threads_512"] = v
+        v = _num(_deep_get(doc, "round_wall.ratio"))
+        if v is not None:
+            out["p50_ratio"] = v
+        v = _num(_deep_get(doc, "churn.rss_ratio"))
+        if v is not None:
+            out["rss_ratio"] = v
+        v = _num(_deep_get(doc, "zero_copy.zero_copy_forwards"))
+        if v is not None:
+            out["zero_copy_forwards"] = v
+        if doc.get("ok") is not None:
+            out["ok"] = bool(doc["ok"])
     elif fname.startswith("FAULTS_"):
         scenarios = doc.get("scenarios")
         if isinstance(scenarios, list):
@@ -342,7 +364,18 @@ GATE_RULES = {
     # on 1-core CI boxes (FEDSHARD throughput_256.note)
     "FEDSHARD_": ({"ok": "true", "ok[*": "true",
                    "fedllm_sharded_leaves": "higher"}, 0.0),
+    "FEDHUB_": ({"ok": "true", "ok[*": "true", "threads_512": "lower",
+                 "p50_ratio": "lower", "rss_ratio": "lower"}, 0.10),
 }
+
+# Correctness-ENFORCING families: a flipped ok/digest-pin boolean here
+# is a broken byte-identity invariant (the pins re-measure determinism,
+# not speed), so the gate exits HARD (2) on it and CI fails the build —
+# while latency-family breaches keep exit 1, which CI downgrades to a
+# warning (committed measurements from dev machines are review prompts,
+# not build breakers).  Only "true"-direction metrics enforce; numeric
+# metrics inside these families stay warn-only like everywhere else.
+ENFORCED_FAMILIES = {"FEDSHARD_", "FEDBUFF_", "FEDHUB_"}
 
 
 def _rule_for(metric: str, rules: dict):
@@ -381,6 +414,8 @@ def gate(records):
                 continue
             cmp = {"family": fam.rstrip("_"), "metric": metric,
                    "old": ov, "new": nv, "tolerance": tol,
+                   "enforced": (fam in ENFORCED_FAMILIES
+                                and direction == "true"),
                    "old_artifact": old["artifact"],
                    "new_artifact": new["artifact"]}
             if direction == "true":
@@ -445,7 +480,9 @@ def main(argv=None) -> int:
         return 2
     if args.gate:
         failures, comparisons = gate(records)
-        doc = {"compared": len(comparisons), "regressions": failures}
+        hard = [f for f in failures if f.get("enforced")]
+        doc = {"compared": len(comparisons), "regressions": failures,
+               "enforced_regressions": hard}
         if args.out:
             with open(args.out, "w") as fh:
                 json.dump(doc, fh, indent=1)
@@ -453,14 +490,19 @@ def main(argv=None) -> int:
             print(json.dumps(doc, indent=1))
         else:
             for c in comparisons:
-                mark = "REGRESSED" if c["regressed"] else "ok"
+                mark = "ok"
+                if c["regressed"]:
+                    mark = "ENFORCED" if c.get("enforced") else "REGRESSED"
                 print(f"{mark:>9}  {c['family']:<12} {c['metric']:<28} "
                       f"{_fmt_val(c['old'])} -> {_fmt_val(c['new'])} "
                       f"(tol {c['tolerance']:.0%}, "
                       f"{c['old_artifact']} -> {c['new_artifact']})")
             print(f"{len(comparisons)} comparisons, "
-                  f"{len(failures)} regression(s)")
-        return 1 if failures else 0
+                  f"{len(failures)} regression(s), "
+                  f"{len(hard)} enforced")
+        # exit 2 = enforced correctness breach (CI fails the build),
+        # exit 1 = warn-only latency breach (CI logs a warning)
+        return 2 if hard else (1 if failures else 0)
     doc = {"artifacts": len(records), "records": records}
     if args.out:
         with open(args.out, "w") as fh:

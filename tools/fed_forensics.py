@@ -459,7 +459,27 @@ def attribute(bundles: Dict[str, dict], clock: Clock,
             {"source": sorted({o["tag"] for o in overruns}),
              "kind": "deadline_overrun", "rounds": rounds}]))
 
-    # 6. server-side tolerance observations without injector bundles
+    # 6. lock contention: the CheckedLock tap recorded real blocking
+    # (acquire waits past the flight threshold) somewhere in the
+    # federation — surfaced as its own verdict so a reactor-loop or
+    # round-lock stall is attributable evidence, not a wall-time
+    # hunch.  Low confidence: contention usually EXPLAINS a latency
+    # symptom rather than being the injected fault, so it must never
+    # shadow a crash/injection verdict (rank keeps it below those).
+    hot = [row for row in lock_contention(bundles)
+           if row["wait_total_s"] >= 0.05 or row["wait_max_s"] >= 0.02]
+    if hot:
+        worst = hot[0]
+        cands.append(verdict("lock_contention", None, "low", [
+            {"source": row["tag"], "kind": "lock_wait",
+             "lock": row["lock"], "contended": row["contended"],
+             "wait_total_s": row["wait_total_s"],
+             "wait_max_s": row["wait_max_s"]}
+            for row in hot[:6]] + [
+            {"source": worst["tag"], "kind": "hottest_lock",
+             "lock": worst["lock"]}]))
+
+    # 7. server-side tolerance observations without injector bundles
     # (with injections on record the rejects are their echo, not a
     # second fault)
     if ev["rejects"] and not inj:
@@ -473,7 +493,7 @@ def attribute(bundles: Dict[str, dict], clock: Clock,
             {"source": "server", "kind": "rejects",
              "what": sorted(whats), "count": len(ev["rejects"])}]))
 
-    # 7. weakest channels: only when nothing stronger found anything
+    # 8. weakest channels: only when nothing stronger found anything
     if not cands and ev["slo_violations"]:
         v = _first(ev["slo_violations"])
         cands.append(verdict("telemetry_loss", v.get("round"), "low", [

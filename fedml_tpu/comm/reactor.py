@@ -37,6 +37,14 @@ from fedml_tpu.comm.message import FRAME_BINLEN_KEY, SHM_SEQ_KEY
 # a runaway accumulation long before it exhausts hub memory.
 DEFAULT_MAX_HEADER = 64 << 20
 
+# Largest ``__binlen__`` a header may announce.  The bound exists so a
+# malformed (but valid-JSON) header can't drive ``pool.acquire`` into a
+# multi-gigabyte ``bytearray`` allocation: anything past it is
+# connection-fatal like a garbled header, never a MemoryError in the
+# event loop.  4 GiB clears every real payload (full fp32 model
+# broadcasts included) by a wide margin.
+DEFAULT_MAX_PAYLOAD = 4 << 30
+
 # Pool buffers below this round up to one page-friendly class; tiny
 # payloads (control frames) then share a handful of hot buffers instead
 # of fragmenting the freelist into dozens of size classes.
@@ -169,10 +177,12 @@ class FrameParser:
     Frames whose header carries the shm doorbell key (``__shmseq__``)
     announce payload bytes that live in the connection's slab, NOT on
     the stream — they complete immediately with no payload/region; the
-    hub maps the slab bytes itself.  A garbled header (bad JSON, or
-    JSON that isn't an object) and an unterminated header past
-    ``max_header_bytes`` raise ``FrameError`` — connection-fatal, the
-    blocking reader's exact policy.
+    hub maps the slab bytes itself.  A garbled header (bad JSON,
+    non-UTF-8 bytes, or JSON that isn't an object), a ``__binlen__``
+    that isn't a non-negative int within ``max_payload_bytes``, and an
+    unterminated header past ``max_header_bytes`` all raise
+    ``FrameError`` — connection-fatal, the blocking reader's exact
+    policy.
 
     Completed frames are ``(hdr, line, payload, region)``: the parsed
     header dict, the raw header line (newline included — forwarding
@@ -184,12 +194,13 @@ class FrameParser:
     PAYLOAD = 1
 
     __slots__ = ("_pool", "_scratch", "_sview", "_hdr", "_max_hdr",
-                 "_state", "_fhdr", "_fline", "_region", "_filled",
-                 "_need")
+                 "_max_payload", "_state", "_fhdr", "_fline", "_region",
+                 "_filled", "_need")
 
     def __init__(self, pool: Optional[BufPool] = None,
                  scratch_bytes: int = 256 << 10,
                  max_header_bytes: int = DEFAULT_MAX_HEADER,
+                 max_payload_bytes: int = DEFAULT_MAX_PAYLOAD,
                  scratch: Optional[bytearray] = None):
         self._pool = pool if pool is not None else BufPool()
         # ``scratch`` may be SHARED across every parser of one event
@@ -205,6 +216,7 @@ class FrameParser:
         self._sview = memoryview(self._scratch)
         self._hdr = bytearray()        # partial header across chunks
         self._max_hdr = int(max_header_bytes)
+        self._max_payload = int(max_payload_bytes)
         self._state = self.HEADER
         self._fhdr: Optional[dict] = None
         self._fline: bytes = b""
@@ -285,8 +297,12 @@ class FrameParser:
                             f"header line of {len(line)} bytes "
                             f"exceeds the {self._max_hdr} cap")
             try:
+                # ValueError, not JSONDecodeError: non-UTF-8 bytes
+                # raise UnicodeDecodeError (a ValueError sibling, NOT a
+                # JSONDecodeError) and must hit the same fatal path —
+                # otherwise one binary-garbage peer kills the loop
                 hdr = json.loads(line)
-            except json.JSONDecodeError as e:
+            except ValueError as e:
                 self._fatal(frames, f"garbled header: {e}")
             if not isinstance(hdr, dict):
                 self._fatal(frames,
@@ -294,13 +310,30 @@ class FrameParser:
                             f"not an object")
             binlen = hdr.get(FRAME_BINLEN_KEY)
             if binlen and SHM_SEQ_KEY not in hdr:
-                self._need = int(binlen)
-                self._fhdr = hdr
-                self._fline = line
-                self._region = self._pool.acquire(self._need)
-                self._filled = 0
-                self._state = self.PAYLOAD
-                continue
+                # binlen comes off the wire: a non-numeric, negative,
+                # or absurd value must die as a FrameError here, never
+                # escape as ValueError / broken PAYLOAD state /
+                # MemoryError inside pool.acquire
+                try:
+                    need = int(binlen)
+                except (TypeError, ValueError):
+                    need = -1
+                if need < 0 or need > self._max_payload:
+                    self._fatal(frames,
+                                f"bad {FRAME_BINLEN_KEY} {binlen!r}: "
+                                f"need an int in "
+                                f"[0, {self._max_payload}]")
+                if need:
+                    self._need = need
+                    self._fhdr = hdr
+                    self._fline = line
+                    self._region = self._pool.acquire(need)
+                    self._filled = 0
+                    self._state = self.PAYLOAD
+                    continue
+                # truthy binlen that still parses to 0 (e.g. "0"):
+                # fall through — a zero-byte payload is a header-only
+                # frame, same as a falsy/absent binlen
             # header-only frame: v1 line, control frame, or an shm
             # doorbell whose bytes live in the slab
             frames.append((hdr, line, b"", None))

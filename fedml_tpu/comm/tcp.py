@@ -896,25 +896,38 @@ class TcpHub:
             t0 = time.perf_counter()
             for key, mask in events:
                 data = key.data
-                if data is None:
-                    self._on_accept()
-                elif data == "wakeup":
-                    try:
-                        while os.read(self._wakeup_r, 4096):
+                try:
+                    if data is None:
+                        self._on_accept()
+                    elif data == "wakeup":
+                        try:
+                            while os.read(self._wakeup_r, 4096):
+                                pass
+                        except (BlockingIOError, OSError):
                             pass
-                    except (BlockingIOError, OSError):
-                        pass
-                else:
-                    if data.dead:
-                        continue
-                    if mask & selectors.EVENT_WRITE:
-                        # a parked conn's socket opened up: resume its
-                        # drain this batch (scheduled stays True while
-                        # parked, so _wake dedup keeps holding)
-                        with self._lock:
-                            self._drainq.append(data)
-                    if mask & selectors.EVENT_READ:
-                        self._on_readable(data)
+                    else:
+                        if data.dead:
+                            continue
+                        if mask & selectors.EVENT_WRITE:
+                            # a parked conn's socket opened up: resume
+                            # its drain this batch (scheduled stays
+                            # True while parked, so _wake dedup keeps
+                            # holding)
+                            with self._lock:
+                                self._drainq.append(data)
+                        if mask & selectors.EVENT_READ:
+                            self._on_readable(data)
+                except Exception:
+                    # last-resort backstop: an escaped event-handler
+                    # error must cost ONE connection, never the loop
+                    # (a dead loop wedges every conn on the hub)
+                    if isinstance(data, _Conn):
+                        logging.exception(
+                            "hub: reactor event error on conn "
+                            "cid=%s — dropping conn", data.cid)
+                        self._close_conn_r(data)
+                    else:
+                        logging.exception("hub: reactor event error")
             self._drain_batch()
             if events:
                 # loop lag: time this batch kept the loop away from
@@ -988,6 +1001,15 @@ class TcpHub:
             except FrameError as e:
                 logging.warning(
                     "hub: conn cid=%s dropped (%s)", st.cid, e)
+                self._close_conn_r(st)
+                return
+            except Exception:
+                # never lose the LOOP to a parser bug (same contract
+                # as the _on_frame catch-all below): the conn dies
+                # alone, _close_conn_r's parser.close() releases any
+                # in-progress pooled region
+                logging.exception(
+                    "hub: parser error on conn cid=%s", st.cid)
                 self._close_conn_r(st)
                 return
             for idx, (frame, line, payload, region) in enumerate(frames):

@@ -152,6 +152,53 @@ def test_parser_garbled_header_fatal():
         _drive(p2, b"[1, 2, 3]\n", [10])  # JSON, but not an object
 
 
+def test_parser_binary_garbage_header_fatal():
+    # non-UTF-8 bytes make json.loads raise UnicodeDecodeError — a
+    # ValueError sibling, NOT a JSONDecodeError subclass — which must
+    # hit the same connection-fatal FrameError path as ASCII garbage
+    p = FrameParser()
+    with pytest.raises(FrameError):
+        _drive(p, b"\x80\x81\x82\n", [4])
+
+
+def test_parser_binary_garbage_releases_inflight_regions():
+    # the _fatal cleanup contract must hold for the UnicodeDecodeError
+    # path too: a completed-but-undelivered frame's pooled region is
+    # released, never leaked
+    pool = BufPool()
+    p = FrameParser(pool=pool)
+    stream = _frame_bytes({"receiver": 1}, b"z" * 64) + b"\xff\xfe\n"
+    with pytest.raises(FrameError):
+        _drive(p, stream, [len(stream)])
+    assert pool.live == 0
+
+
+@pytest.mark.parametrize("binlen", ["not-a-number", -5, [1], 1 << 62])
+def test_parser_bad_binlen_fatal(binlen):
+    # __binlen__ comes off the wire: non-numeric (ValueError/TypeError
+    # in int()), negative (broken PAYLOAD slice state), and absurd
+    # (MemoryError inside pool.acquire) values must all die as
+    # FrameError under the parser's own fatal policy
+    pool = BufPool()
+    p = FrameParser(pool=pool)
+    line = (json.dumps({"receiver": 1, FRAME_BINLEN_KEY: binlen})
+            + "\n").encode()
+    with pytest.raises(FrameError):
+        _drive(p, line, [len(line)])
+    assert pool.live == 0
+
+
+def test_parser_binlen_zero_string_is_header_only():
+    # "0" is truthy but announces zero payload bytes: same as an
+    # absent binlen — a header-only frame, no pooled region
+    p = FrameParser()
+    line = (json.dumps({"receiver": 1, FRAME_BINLEN_KEY: "0"})
+            + "\n").encode()
+    frames = _drive(p, line, [len(line)])
+    assert len(frames) == 1
+    assert frames[0][2] == b"" and frames[0][3] is None
+
+
 def test_parser_fatal_releases_inflight_regions():
     # a garbled header after a completed-payload frame in the same
     # chunk must not leak the completed frame's pooled region
@@ -254,6 +301,53 @@ def test_reactor_garbled_header_drops_conn_only():
         good.close()
         bad.close()
     finally:
+        hub.stop()
+
+
+def test_reactor_binary_garbage_drops_conn_only():
+    """Regression: non-UTF-8 bytes with a newline used to raise
+    UnicodeDecodeError past the FrameError handler and kill the single
+    reactor thread — wedging EVERY connection on the hub, where
+    threaded mode lost only the one conn.  The hostile conn must die
+    alone and the loop must keep accepting."""
+    hub = TcpHub()
+    socks = []
+    try:
+        good = _dial_raw(hub.host, hub.port, 1)
+        bad = _dial_raw(hub.host, hub.port, 2)
+        socks += [good, bad]
+        _wait(lambda: hub.stats()["connections"] == 2)
+        bad.sendall(b"\x80\x81\x82\n")
+        _wait(lambda: hub.stats()["connections"] == 1)
+        assert hub.stats()["threads"] == 1
+        # the loop survived: a fresh dial still registers
+        socks.append(_dial_raw(hub.host, hub.port, 3))
+        _wait(lambda: hub.stats()["connections"] == 2)
+    finally:
+        for s in socks:
+            s.close()
+        hub.stop()
+
+
+def test_reactor_hostile_binlen_drops_conn_only():
+    # a valid-JSON header announcing an absurd __binlen__ must not
+    # become a MemoryError in the event loop: connection-fatal, loop
+    # and cohort survive
+    hub = TcpHub()
+    socks = []
+    try:
+        good = _dial_raw(hub.host, hub.port, 1)
+        bad = _dial_raw(hub.host, hub.port, 2)
+        socks += [good, bad]
+        _wait(lambda: hub.stats()["connections"] == 2)
+        bad.sendall((json.dumps(
+            {"msg_type": "X", FRAME_BINLEN_KEY: 1 << 60}) + "\n"
+        ).encode())
+        _wait(lambda: hub.stats()["connections"] == 1)
+        assert hub.stats()["threads"] == 1
+    finally:
+        for s in socks:
+            s.close()
         hub.stop()
 
 
